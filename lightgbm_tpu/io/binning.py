@@ -1,0 +1,429 @@
+"""Feature binning (value -> bin index mapping).
+
+TPU-native counterpart of the reference BinMapper (reference:
+include/LightGBM/bin.h:61, src/io/bin.cpp:74-365). Host-side, numpy-based:
+binning is one-time preprocessing; the binned uint8/uint16 matrix is what
+lives in HBM. Semantics follow the reference exactly so that bin boundaries
+(and therefore trees) match:
+
+- ``greedy_find_bin``       <- GreedyFindBin (src/io/bin.cpp:74)
+- ``find_bin_with_zero_as_one_bin`` <- FindBinWithZeroAsOneBin (bin.cpp:152)
+- ``BinMapper.find_bin``    <- BinMapper::FindBin (bin.cpp:208)
+- ``BinMapper.value_to_bin`` <- BinMapper::ValueToBin (bin.h:452)
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import log
+
+KZERO_THRESHOLD = 1e-35          # meta.h:40
+_DOUBLE_EPS = 1e-300
+
+
+class MissingType:
+    NONE = 0
+    ZERO = 1
+    NAN = 2
+
+
+class BinType:
+    NUMERICAL = 0
+    CATEGORICAL = 1
+
+
+def _get_double_upper_bound(x: float) -> float:
+    """Common::GetDoubleUpperBound — smallest double > x representable as the
+    midpoint; the reference nudges up by ulp. np.nextafter matches."""
+    return float(np.nextafter(x, np.inf))
+
+
+def _check_double_equal(a: float, b: float) -> bool:
+    """Common::CheckDoubleEqualOrdered(a, b): a >= b after upper-bounding."""
+    upper = np.nextafter(a, np.inf)
+    return bool(upper >= b)
+
+
+def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                    max_bin: int, total_cnt: int,
+                    min_data_in_bin: int) -> List[float]:
+    """Quantile-ish greedy binning over distinct values (bin.cpp:74-150)."""
+    num_distinct = len(distinct_values)
+    bin_upper_bound: List[float] = []
+    assert max_bin > 0
+    if num_distinct <= max_bin:
+        cur_cnt_inbin = 0
+        for i in range(num_distinct - 1):
+            cur_cnt_inbin += int(counts[i])
+            if cur_cnt_inbin >= min_data_in_bin:
+                val = _get_double_upper_bound(
+                    (float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0)
+                if not bin_upper_bound or not _check_double_equal(bin_upper_bound[-1], val):
+                    bin_upper_bound.append(val)
+                    cur_cnt_inbin = 0
+        bin_upper_bound.append(np.inf)
+    else:
+        if min_data_in_bin > 0:
+            max_bin = min(max_bin, int(total_cnt // min_data_in_bin))
+            max_bin = max(max_bin, 1)
+        mean_bin_size = total_cnt / max_bin
+        rest_bin_cnt = max_bin
+        rest_sample_cnt = int(total_cnt)
+        is_big = counts >= mean_bin_size
+        rest_bin_cnt -= int(is_big.sum())
+        rest_sample_cnt -= int(counts[is_big].sum())
+        mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+        upper_bounds = [np.inf] * max_bin
+        lower_bounds = [np.inf] * max_bin
+
+        bin_cnt = 0
+        lower_bounds[0] = float(distinct_values[0])
+        cur_cnt_inbin = 0
+        for i in range(num_distinct - 1):
+            if not is_big[i]:
+                rest_sample_cnt -= int(counts[i])
+            cur_cnt_inbin += int(counts[i])
+            if (is_big[i] or cur_cnt_inbin >= mean_bin_size or
+                    (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))):
+                upper_bounds[bin_cnt] = float(distinct_values[i])
+                bin_cnt += 1
+                lower_bounds[bin_cnt] = float(distinct_values[i + 1])
+                if bin_cnt >= max_bin - 1:
+                    break
+                cur_cnt_inbin = 0
+                if not is_big[i]:
+                    rest_bin_cnt -= 1
+                    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+        bin_cnt += 1
+        for i in range(bin_cnt - 1):
+            val = _get_double_upper_bound((upper_bounds[i] + lower_bounds[i + 1]) / 2.0)
+            if not bin_upper_bound or not _check_double_equal(bin_upper_bound[-1], val):
+                bin_upper_bound.append(val)
+        bin_upper_bound.append(np.inf)
+    return bin_upper_bound
+
+
+def find_bin_with_zero_as_one_bin(distinct_values: np.ndarray,
+                                  counts: np.ndarray, max_bin: int,
+                                  total_sample_cnt: int,
+                                  min_data_in_bin: int) -> List[float]:
+    """Dedicated zero bin straddling ±kZeroThreshold (bin.cpp:152-206)."""
+    dv = np.asarray(distinct_values, dtype=np.float64)
+    cnts = np.asarray(counts, dtype=np.int64)
+    left_mask = dv <= -KZERO_THRESHOLD
+    right_mask = dv > KZERO_THRESHOLD
+    zero_mask = ~left_mask & ~right_mask
+    left_cnt_data = int(cnts[left_mask].sum())
+    cnt_zero = int(cnts[zero_mask].sum())
+    right_cnt_data = int(cnts[right_mask].sum())
+
+    nz = np.nonzero(dv > -KZERO_THRESHOLD)[0]
+    left_cnt = int(nz[0]) if len(nz) else len(dv)
+
+    bin_upper_bound: List[float] = []
+    if left_cnt > 0:
+        denom = total_sample_cnt - cnt_zero
+        left_max_bin = int(left_cnt_data / max(denom, 1) * (max_bin - 1))
+        left_max_bin = max(1, left_max_bin)
+        bin_upper_bound = greedy_find_bin(dv[:left_cnt], cnts[:left_cnt],
+                                          left_max_bin, left_cnt_data,
+                                          min_data_in_bin)
+        bin_upper_bound[-1] = -KZERO_THRESHOLD
+
+    nz = np.nonzero(dv[left_cnt:] > KZERO_THRESHOLD)[0]
+    right_start = left_cnt + int(nz[0]) if len(nz) else -1
+
+    if right_start >= 0:
+        right_max_bin = max_bin - 1 - len(bin_upper_bound)
+        assert right_max_bin > 0
+        right_bounds = greedy_find_bin(dv[right_start:], cnts[right_start:],
+                                       right_max_bin, right_cnt_data,
+                                       min_data_in_bin)
+        bin_upper_bound.append(KZERO_THRESHOLD)
+        bin_upper_bound.extend(right_bounds)
+    else:
+        bin_upper_bound.append(np.inf)
+    return bin_upper_bound
+
+
+class BinMapper:
+    """Per-feature value->bin mapping (bin.h:61)."""
+
+    def __init__(self):
+        self.num_bin: int = 1
+        self.missing_type: int = MissingType.NONE
+        self.bin_type: int = BinType.NUMERICAL
+        self.is_trivial: bool = True
+        self.sparse_rate: float = 0.0
+        self.bin_upper_bound: np.ndarray = np.array([np.inf])
+        self.bin_2_categorical: List[int] = []
+        self.categorical_2_bin: dict = {}
+        self.min_val: float = 0.0
+        self.max_val: float = 0.0
+        self.default_bin: int = 0
+
+    # -- construction -------------------------------------------------------
+
+    def find_bin(self, values: np.ndarray, total_sample_cnt: int,
+                 max_bin: int, min_data_in_bin: int, min_split_data: int,
+                 bin_type: int = BinType.NUMERICAL, use_missing: bool = True,
+                 zero_as_missing: bool = False) -> None:
+        """BinMapper::FindBin (bin.cpp:208-365).
+
+        ``values`` are the *sampled* non-trivial values; zeros are implied:
+        total_sample_cnt - len(values) zeros (before NaN removal).
+        """
+        values = np.asarray(values, dtype=np.float64)
+        num_sample_values = len(values)
+        nan_mask = np.isnan(values)
+        na_cnt = int(nan_mask.sum())
+        values = values[~nan_mask]
+
+        if not use_missing:
+            self.missing_type = MissingType.NONE
+        elif zero_as_missing:
+            self.missing_type = MissingType.ZERO
+        else:
+            self.missing_type = (MissingType.NONE if na_cnt == 0
+                                 else MissingType.NAN)
+        if not use_missing:
+            na_cnt = 0
+
+        self.bin_type = bin_type
+        self.default_bin = 0
+        zero_cnt = int(total_sample_cnt - len(values) - na_cnt)
+
+        # distinct values with zero spliced at its sorted position
+        values = np.sort(values)
+        distinct_values: List[float] = []
+        counts: List[int] = []
+        if len(values) == 0 or (values[0] > 0.0 and zero_cnt > 0):
+            distinct_values.append(0.0)
+            counts.append(zero_cnt)
+        if len(values) > 0:
+            distinct_values.append(float(values[0]))
+            counts.append(1)
+        for i in range(1, len(values)):
+            prev, cur = float(values[i - 1]), float(values[i])
+            if not _check_double_equal(prev, cur):
+                if prev < 0.0 and cur > 0.0:
+                    distinct_values.append(0.0)
+                    counts.append(zero_cnt)
+                distinct_values.append(cur)
+                counts.append(1)
+            else:
+                distinct_values[-1] = cur
+                counts[-1] += 1
+        if len(values) > 0 and values[-1] < 0.0 and zero_cnt > 0:
+            distinct_values.append(0.0)
+            counts.append(zero_cnt)
+
+        self.min_val = distinct_values[0]
+        self.max_val = distinct_values[-1]
+        dv = np.array(distinct_values)
+        cnts = np.array(counts, dtype=np.int64)
+
+        if bin_type == BinType.NUMERICAL:
+            if self.missing_type == MissingType.ZERO:
+                bounds = find_bin_with_zero_as_one_bin(
+                    dv, cnts, max_bin, total_sample_cnt, min_data_in_bin)
+                if len(bounds) == 2:
+                    self.missing_type = MissingType.NONE
+            elif self.missing_type == MissingType.NONE:
+                bounds = find_bin_with_zero_as_one_bin(
+                    dv, cnts, max_bin, total_sample_cnt, min_data_in_bin)
+            else:
+                bounds = find_bin_with_zero_as_one_bin(
+                    dv, cnts, max_bin - 1, total_sample_cnt - na_cnt,
+                    min_data_in_bin)
+                bounds.append(np.nan)
+            self.bin_upper_bound = np.array(bounds)
+            self.num_bin = len(bounds)
+            # default bin = bin containing 0.0
+            self.default_bin = self._numerical_bin_for(0.0)
+            cnt_in_bin = self._count_in_bins(dv, cnts, na_cnt)
+        else:
+            self._find_bin_categorical(dv, cnts, max_bin, total_sample_cnt,
+                                       min_data_in_bin, na_cnt)
+            cnt_in_bin = list(self._cat_cnt_in_bin)
+
+        # trivial check (bin.cpp: num_bin <= 1 or one-sided filter)
+        self.is_trivial = self.num_bin <= 1
+        if not self.is_trivial and min_split_data > 0:
+            self.is_trivial = self._need_filter(cnt_in_bin, total_sample_cnt,
+                                                min_split_data)
+        if total_sample_cnt > 0 and cnt_in_bin:
+            self.sparse_rate = cnt_in_bin[self.default_bin] / total_sample_cnt
+
+    def _numerical_bin_for(self, value: float) -> int:
+        r = self.num_bin - 1
+        if self.missing_type == MissingType.NAN:
+            r -= 1
+        bounds = self.bin_upper_bound[:r]
+        return int(np.searchsorted(bounds, value, side="left"))
+
+    def _count_in_bins(self, dv, cnts, na_cnt) -> List[int]:
+        cnt_in_bin = [0] * self.num_bin
+        i_bin = 0
+        for v, c in zip(dv, cnts):
+            while v > self.bin_upper_bound[i_bin]:
+                i_bin += 1
+            cnt_in_bin[i_bin] += int(c)
+        if self.missing_type == MissingType.NAN:
+            cnt_in_bin[self.num_bin - 1] = na_cnt
+        return cnt_in_bin
+
+    def _need_filter(self, cnt_in_bin, total_cnt, filter_cnt) -> bool:
+        """NeedFilter (bin.cpp:44-73): no split point leaves filter_cnt on
+        both sides -> feature is unusable."""
+        if self.bin_type == BinType.NUMERICAL:
+            sum_left = 0
+            for i in range(self.num_bin - 1):
+                sum_left += cnt_in_bin[i]
+                if sum_left >= filter_cnt and total_cnt - sum_left >= filter_cnt:
+                    return False
+            return True
+        else:
+            if len(cnt_in_bin) <= 2:
+                for i in range(len(cnt_in_bin) - 1):
+                    sum_left = cnt_in_bin[i]
+                    if sum_left >= filter_cnt and total_cnt - sum_left >= filter_cnt:
+                        return False
+                return True
+            return False
+
+    def _find_bin_categorical(self, dv, cnts, max_bin, total_sample_cnt,
+                              min_data_in_bin, na_cnt) -> None:
+        """Categorical branch of FindBin (bin.cpp:304-365)."""
+        distinct_int: List[int] = []
+        counts_int: List[int] = []
+        for v, c in zip(dv, cnts):
+            iv = int(v)
+            if iv < 0:
+                na_cnt += int(c)
+                log.warning("Met negative value in categorical features, "
+                            "will convert it to NaN")
+            elif distinct_int and iv == distinct_int[-1]:
+                counts_int[-1] += int(c)
+            else:
+                distinct_int.append(iv)
+                counts_int.append(int(c))
+        self.num_bin = 0
+        self._cat_cnt_in_bin: List[int] = []
+        rest_cnt = total_sample_cnt - na_cnt
+        if rest_cnt > 0:
+            if distinct_int and distinct_int[-1] // 100 > len(distinct_int):
+                log.warning("Met categorical feature which contains sparse "
+                            "values. Consider renumbering to consecutive "
+                            "integers started from zero")
+            order = np.argsort(-np.array(counts_int), kind="stable")
+            counts_int = [counts_int[i] for i in order]
+            distinct_int = [distinct_int[i] for i in order]
+            if distinct_int and distinct_int[0] == 0:
+                if len(counts_int) == 1:
+                    counts_int.append(0)
+                    distinct_int.append(distinct_int[0] + 1)
+                counts_int[0], counts_int[1] = counts_int[1], counts_int[0]
+                distinct_int[0], distinct_int[1] = distinct_int[1], distinct_int[0]
+            cut_cnt = int((total_sample_cnt - na_cnt) * 0.99)
+            self.bin_2_categorical = []
+            self.categorical_2_bin = {}
+            used_cnt = 0
+            max_bin = min(len(distinct_int), max_bin)
+            cur_cat = 0
+            while (cur_cat < len(distinct_int)
+                   and (used_cnt < cut_cnt or self.num_bin < max_bin)):
+                if counts_int[cur_cat] < min_data_in_bin and cur_cat > 1:
+                    break
+                self.bin_2_categorical.append(distinct_int[cur_cat])
+                self.categorical_2_bin[distinct_int[cur_cat]] = self.num_bin
+                used_cnt += counts_int[cur_cat]
+                self._cat_cnt_in_bin.append(counts_int[cur_cat])
+                self.num_bin += 1
+                cur_cat += 1
+            if cur_cat == len(distinct_int) and na_cnt > 0:
+                self.missing_type = MissingType.NAN
+                self.num_bin += 1
+                self._cat_cnt_in_bin.append(na_cnt)
+            else:
+                self.missing_type = MissingType.NONE
+                if self.num_bin < len(distinct_int) or na_cnt > 0:
+                    # leftover cats fall in the "other" last bin
+                    leftover = (total_sample_cnt - na_cnt - used_cnt) + na_cnt
+                    if self._cat_cnt_in_bin:
+                        self._cat_cnt_in_bin[-1] += 0
+            self.default_bin = 0
+
+    # -- mapping ------------------------------------------------------------
+
+    def value_to_bin(self, value):
+        """Vectorized BinMapper::ValueToBin (bin.h:452-488)."""
+        values = np.asarray(value, dtype=np.float64)
+        scalar = values.ndim == 0
+        values = np.atleast_1d(values)
+        if self.bin_type == BinType.NUMERICAL:
+            out = np.empty(values.shape, dtype=np.int32)
+            nan_mask = np.isnan(values)
+            v = np.where(nan_mask, 0.0, values)
+            r = self.num_bin - 1
+            if self.missing_type == MissingType.NAN:
+                r -= 1
+            # left bound binary search: first bin with value <= upper_bound
+            out[:] = np.searchsorted(self.bin_upper_bound[:r], v, side="left")
+            if self.missing_type == MissingType.NAN:
+                out[nan_mask] = self.num_bin - 1
+        else:
+            out = np.full(values.shape, self.num_bin - 1, dtype=np.int32)
+            iv = values.astype(np.int64, copy=False)
+            iv = np.where(np.isnan(values), -1, iv)
+            for cat, b in self.categorical_2_bin.items():
+                out[iv == cat] = b
+        return int(out[0]) if scalar else out
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """BinToValue (bin.h:109): numerical -> upper bound; cat -> category."""
+        if self.bin_type == BinType.NUMERICAL:
+            return float(self.bin_upper_bound[bin_idx])
+        return float(self.bin_2_categorical[bin_idx])
+
+    # -- serialization ------------------------------------------------------
+
+    def feature_info(self) -> str:
+        """String for the model header `feature_infos=` (dataset.cpp)."""
+        if self.is_trivial:
+            return "none"
+        if self.bin_type == BinType.NUMERICAL:
+            return f"[{self.min_val:g}:{self.max_val:g}]"
+        return ":".join(str(c) for c in self.bin_2_categorical)
+
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "missing_type": self.missing_type,
+            "bin_type": self.bin_type,
+            "is_trivial": self.is_trivial,
+            "sparse_rate": self.sparse_rate,
+            "bin_upper_bound": self.bin_upper_bound.tolist(),
+            "bin_2_categorical": list(self.bin_2_categorical),
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+            "default_bin": self.default_bin,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = d["num_bin"]
+        m.missing_type = d["missing_type"]
+        m.bin_type = d["bin_type"]
+        m.is_trivial = d["is_trivial"]
+        m.sparse_rate = d["sparse_rate"]
+        m.bin_upper_bound = np.array(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = list(d["bin_2_categorical"])
+        m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        m.min_val = d["min_val"]
+        m.max_val = d["max_val"]
+        m.default_bin = d["default_bin"]
+        return m

@@ -1,0 +1,248 @@
+"""Binned dataset container.
+
+TPU-native counterpart of the reference Dataset/Metadata/FeatureGroup
+(reference: include/LightGBM/dataset.h:36-622, src/io/dataset.cpp:212,
+src/io/metadata.cpp). The reference stores per-group CPU bin arrays with
+4/8/16/32-bit widths; here the binned matrix is ONE dense device tensor
+``[N, F] uint8/int32`` resident in HBM (the GPU learner already did the
+dense-only device layout, gpu_tree_learner.cpp:325-357 — we follow that
+design and keep every non-trivial feature dense).
+
+Host-side responsibilities: sampling, BinMapper construction
+(Dataset::Construct / DatasetLoader::ConstructBinMappersFromTextData),
+trivial-feature exclusion, metadata (labels/weights/queries/init scores).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..ops.split import FeatureMeta
+from ..utils import log
+from .binning import BinMapper, BinType
+
+
+class Metadata:
+    """Labels / weights / queries / init scores (dataset.h:36-249)."""
+
+    def __init__(self, label=None, weight=None, group=None, init_score=None):
+        self.label = (None if label is None
+                      else np.asarray(label, np.float32).reshape(-1))
+        self.weights = (None if weight is None
+                        else np.asarray(weight, np.float32).reshape(-1))
+        self.init_score = (None if init_score is None
+                           else np.asarray(init_score, np.float64))
+        self.query_boundaries = None
+        if group is not None:
+            group = np.asarray(group, np.int64).reshape(-1)
+            self.query_boundaries = np.concatenate(
+                [[0], np.cumsum(group)]).astype(np.int64)
+        self._query_weights = None
+
+    def check_or_partition(self, num_data: int) -> None:
+        if self.label is not None and len(self.label) != num_data:
+            log.fatal(f"Length of label ({len(self.label)}) is not same "
+                      f"as number of data ({num_data})")
+        if self.weights is not None and len(self.weights) != num_data:
+            log.fatal("Length of weights differs from number of data")
+        if (self.query_boundaries is not None
+                and self.query_boundaries[-1] != num_data):
+            log.fatal("Sum of query counts differs from number of data")
+
+    @property
+    def num_queries(self):
+        if self.query_boundaries is None:
+            return 0
+        return len(self.query_boundaries) - 1
+
+
+class TpuDataset:
+    """Constructed, binned training matrix + metadata."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.num_data = 0
+        self.num_total_features = 0
+        self.mappers: List[BinMapper] = []          # per used (inner) feature
+        self.used_feature_map: np.ndarray = np.array([], np.int32)
+        self.real_to_inner: dict = {}
+        self.bins: Optional[np.ndarray] = None      # [N, F_used]
+        self.metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.max_bin_global = 1
+        self._reference: Optional["TpuDataset"] = None
+
+    # -- construction -------------------------------------------------------
+
+    def construct_from_matrix(self, X: np.ndarray, metadata: Metadata,
+                              categorical: Sequence[int] = (),
+                              reference: Optional["TpuDataset"] = None,
+                              feature_names: Optional[List[str]] = None):
+        """Build bin mappers (or reuse reference's) and bin the matrix.
+
+        Mirrors DatasetLoader::ConstructFromSampleData
+        (src/io/dataset_loader.cpp:499) + Dataset::CreateValid
+        (src/io/dataset.cpp:368).
+        """
+        X = np.asarray(X)
+        if X.dtype not in (np.float32, np.float64):
+            X = X.astype(np.float64)
+        n, nf = X.shape
+        self.num_data = n
+        self.num_total_features = nf
+        self.metadata = metadata
+        self.metadata.check_or_partition(n)
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(nf)])
+
+        if reference is not None:
+            # valid set: reuse the train set's mappers (CreateValid)
+            self._reference = reference
+            self.mappers = reference.mappers
+            self.used_feature_map = reference.used_feature_map
+            self.real_to_inner = reference.real_to_inner
+            self.max_bin_global = reference.max_bin_global
+            self.feature_names = reference.feature_names
+            self.num_total_features = reference.num_total_features
+        else:
+            self._construct_mappers(X, set(categorical))
+        self._bin_matrix(X)
+        return self
+
+    def _construct_mappers(self, X: np.ndarray, categorical: set) -> None:
+        cfg = self.config
+        n, nf = X.shape
+        # sampling (DatasetLoader::LoadFromFile sampling path,
+        # dataset_loader.cpp:196-235): sample rows for bin construction
+        sample_cnt = min(cfg.bin_construct_sample_cnt, n)
+        rng = np.random.default_rng(cfg.data_random_seed)
+        if sample_cnt < n:
+            sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+            sample = X[sample_idx]
+        else:
+            sample = X
+        total = sample.shape[0]
+
+        filter_cnt = 0
+        if cfg.min_data_in_leaf > 0 and n > 0:
+            # dataset_loader.cpp: filter scaled by sample/total ratio
+            filter_cnt = max(
+                int(cfg.min_data_in_leaf * total / n), 1)
+
+        used, mappers = [], []
+        for j in range(nf):
+            col = sample[:, j].astype(np.float64)
+            # reference samples only non-zero values; zeros are implied
+            nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+            m = BinMapper()
+            bt = (BinType.CATEGORICAL if j in categorical
+                  else BinType.NUMERICAL)
+            m.find_bin(nonzero, total, cfg.max_bin, cfg.min_data_in_bin,
+                       filter_cnt, bt, cfg.use_missing, cfg.zero_as_missing)
+            if not m.is_trivial:
+                used.append(j)
+                mappers.append(m)
+        if not mappers:
+            log.warning("There are no meaningful features, as all feature "
+                        "values are constant.")
+        self.mappers = mappers
+        self.used_feature_map = np.asarray(used, np.int32)
+        self.real_to_inner = {r: i for i, r in enumerate(used)}
+        self.max_bin_global = max((m.num_bin for m in mappers), default=1)
+
+    def _bin_matrix(self, X: np.ndarray) -> None:
+        n = X.shape[0]
+        f = len(self.mappers)
+        dtype = np.uint8 if self.max_bin_global <= 256 else np.int32
+        bins = np.zeros((n, max(f, 1)), dtype)
+        for i, real in enumerate(self.used_feature_map):
+            bins[:, i] = self.mappers[i].value_to_bin(X[:, real]).astype(dtype)
+        self.bins = bins
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def num_features(self) -> int:
+        return len(self.mappers)
+
+    def feature_meta(self) -> FeatureMeta:
+        mono = None
+        if self.config.monotone_constraints:
+            mono = [0] * self.num_features
+            for i, real in enumerate(self.used_feature_map):
+                if real < len(self.config.monotone_constraints):
+                    mono[i] = self.config.monotone_constraints[real]
+        contri = None
+        if self.config.feature_contri:
+            contri = [1.0] * self.num_features
+            for i, real in enumerate(self.used_feature_map):
+                if real < len(self.config.feature_contri):
+                    contri[i] = self.config.feature_contri[real]
+        return FeatureMeta.from_mappers(self.mappers, mono, contri)
+
+    def feature_infos(self) -> List[str]:
+        """Per REAL feature; 'none' for unused (model header parity)."""
+        infos = []
+        for real in range(self.num_total_features):
+            inner = self.real_to_inner.get(real)
+            infos.append("none" if inner is None
+                         else self.mappers[inner].feature_info())
+        return infos
+
+    def create_valid(self, X: np.ndarray, metadata: Metadata) -> "TpuDataset":
+        v = TpuDataset(self.config)
+        v.construct_from_matrix(np.asarray(X), metadata, reference=self)
+        return v
+
+    # -- binary cache (SaveBinaryFile parity, dataset.cpp:542) --------------
+
+    BINARY_TOKEN = b"______LightGBM_TPU_Binary_File_Token______\n"
+
+    def save_binary(self, filename: str) -> None:
+        import pickle
+        with open(filename, "wb") as fh:
+            fh.write(self.BINARY_TOKEN)
+            pickle.dump({
+                "num_data": self.num_data,
+                "num_total_features": self.num_total_features,
+                "mappers": [m.to_dict() for m in self.mappers],
+                "used_feature_map": self.used_feature_map,
+                "bins": self.bins,
+                "label": self.metadata.label,
+                "weights": self.metadata.weights,
+                "query_boundaries": self.metadata.query_boundaries,
+                "init_score": self.metadata.init_score,
+                "feature_names": self.feature_names,
+            }, fh, protocol=4)
+        log.info("Saved binary dataset to %s", filename)
+
+    @classmethod
+    def is_binary_file(cls, filename: str) -> bool:
+        try:
+            with open(filename, "rb") as fh:
+                return fh.read(len(cls.BINARY_TOKEN)) == cls.BINARY_TOKEN
+        except OSError:
+            return False
+
+    @classmethod
+    def load_binary(cls, filename: str, config: Config) -> "TpuDataset":
+        import pickle
+        with open(filename, "rb") as fh:
+            tok = fh.read(len(cls.BINARY_TOKEN))
+            if tok != cls.BINARY_TOKEN:
+                log.fatal(f"{filename} is not a lightgbm_tpu binary file")
+            d = pickle.load(fh)
+        ds = cls(config)
+        ds.num_data = d["num_data"]
+        ds.num_total_features = d["num_total_features"]
+        ds.mappers = [BinMapper.from_dict(m) for m in d["mappers"]]
+        ds.used_feature_map = d["used_feature_map"]
+        ds.real_to_inner = {r: i for i, r in enumerate(ds.used_feature_map)}
+        ds.bins = d["bins"]
+        ds.metadata = Metadata(d["label"], d["weights"], None, d["init_score"])
+        ds.metadata.query_boundaries = d["query_boundaries"]
+        ds.feature_names = d["feature_names"]
+        ds.max_bin_global = max((m.num_bin for m in ds.mappers), default=1)
+        return ds

@@ -1,0 +1,3 @@
+from .metric import Metric, create_metric, create_metrics, metric_alias
+
+__all__ = ["Metric", "create_metric", "create_metrics", "metric_alias"]

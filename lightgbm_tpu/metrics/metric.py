@@ -1,0 +1,434 @@
+"""Evaluation metrics.
+
+TPU-native counterparts of the reference metrics
+(reference: src/metric/metric.cpp:11-55 factory; regression_metric.hpp,
+binary_metric.hpp, multiclass_metric.hpp, rank_metric.hpp, map_metric.hpp,
+xentropy_metric.hpp, dcg_calculator.cpp). Numpy-vectorized host
+implementations — metric evaluation is once-per-iteration O(N) work on
+scores already pulled from device.
+
+Scores arrive class-major ``[K, N]`` like the reference's score buffer;
+``objective.convert_output`` supplies the raw->output transform exactly as
+Metric::Eval receives the objective pointer (include/LightGBM/metric.h:40).
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+
+
+class Metric:
+    name = "base"
+    bigger_is_better = False
+
+    def __init__(self, config):
+        self.config = config
+
+    def init(self, metadata, num_data):
+        self.label = (np.asarray(metadata.label, np.float64)
+                      if metadata.label is not None else np.zeros(num_data))
+        self.weights = (np.asarray(metadata.weights, np.float64)
+                        if metadata.weights is not None else None)
+        self.query_boundaries = metadata.query_boundaries
+        self.num_data = num_data
+        self.sum_weights = (float(num_data) if self.weights is None
+                            else float(np.sum(self.weights)))
+
+    def eval(self, score: np.ndarray, objective) -> List[tuple]:
+        raise NotImplementedError
+
+    def _avg(self, losses: np.ndarray) -> float:
+        if self.weights is None:
+            return float(np.mean(losses))
+        return float(np.sum(losses * self.weights) / self.sum_weights)
+
+    def _convert(self, score: np.ndarray, objective) -> np.ndarray:
+        if objective is not None:
+            import jax.numpy as jnp
+            return np.asarray(objective.convert_output(jnp.asarray(score)))
+        return score
+
+
+# --- regression family (src/metric/regression_metric.hpp) -----------------
+
+class _PointwiseMetric(Metric):
+    def eval(self, score, objective):
+        s = self._convert(score[0] if score.ndim > 1 else score, objective)
+        return [(self.name, self._avg(self.loss(self.label, s)))]
+
+
+class L2Metric(_PointwiseMetric):
+    name = "l2"
+
+    @staticmethod
+    def loss(y, s):
+        return (y - s) ** 2
+
+
+class RMSEMetric(_PointwiseMetric):
+    name = "rmse"
+
+    def eval(self, score, objective):
+        s = self._convert(score[0] if score.ndim > 1 else score, objective)
+        return [(self.name, math.sqrt(self._avg((self.label - s) ** 2)))]
+
+
+class L1Metric(_PointwiseMetric):
+    name = "l1"
+
+    @staticmethod
+    def loss(y, s):
+        return np.abs(y - s)
+
+
+class QuantileMetric(_PointwiseMetric):
+    name = "quantile"
+
+    def loss(self, y, s):
+        a = self.config.alpha
+        d = y - s
+        return np.where(d >= 0, a * d, (a - 1.0) * d)
+
+
+class HuberLossMetric(_PointwiseMetric):
+    name = "huber"
+
+    def loss(self, y, s):
+        a = self.config.alpha
+        d = np.abs(s - y)
+        return np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+
+
+class FairLossMetric(_PointwiseMetric):
+    name = "fair"
+
+    def loss(self, y, s):
+        c = self.config.fair_c
+        x = np.abs(s - y)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseMetric):
+    name = "poisson"
+
+    @staticmethod
+    def loss(y, s):
+        eps = 1e-10
+        s = np.maximum(s, eps)
+        return s - y * np.log(s)
+
+
+class MAPEMetric(_PointwiseMetric):
+    name = "mape"
+
+    @staticmethod
+    def loss(y, s):
+        return np.abs((y - s) / np.maximum(1.0, np.abs(y)))
+
+
+class GammaMetric(_PointwiseMetric):
+    name = "gamma"
+
+    @staticmethod
+    def loss(y, s):
+        eps = 1e-10
+        psi = 1.0
+        theta = -1.0 / np.maximum(s, eps)
+        a = psi
+        b = -np.log(-theta)
+        c = 1.0 / psi * np.log(y / psi) - np.log(y) - math.lgamma(1.0 / psi)
+        return -((y * theta - b) / a + c)
+
+
+class GammaDevianceMetric(_PointwiseMetric):
+    name = "gamma_deviance"
+
+    def eval(self, score, objective):
+        s = self._convert(score[0] if score.ndim > 1 else score, objective)
+        eps = 1e-10
+        frac = self.label / np.maximum(s, eps)
+        loss = -np.log(np.maximum(frac, eps)) + frac - 1.0
+        return [(self.name, float(2.0 * np.sum(loss)))]
+
+
+class TweedieMetric(_PointwiseMetric):
+    name = "tweedie"
+
+    def loss(self, y, s):
+        rho = self.config.tweedie_variance_power
+        eps = 1e-10
+        s = np.maximum(s, eps)
+        a = y * np.power(s, 1.0 - rho) / (1.0 - rho)
+        b = np.power(s, 2.0 - rho) / (2.0 - rho)
+        return -a + b
+
+
+# --- binary (src/metric/binary_metric.hpp) --------------------------------
+
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+
+    def eval(self, score, objective):
+        p = self._convert(score[0] if score.ndim > 1 else score, objective)
+        eps = 1e-15
+        p = np.clip(p, eps, 1.0 - eps)
+        y = (self.label > 0).astype(np.float64)
+        loss = -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+        return [(self.name, self._avg(loss))]
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+
+    def eval(self, score, objective):
+        p = self._convert(score[0] if score.ndim > 1 else score, objective)
+        y = (self.label > 0)
+        pred = p > 0.5
+        return [(self.name, self._avg((pred != y).astype(np.float64)))]
+
+
+class AUCMetric(Metric):
+    """AUC (binary_metric.hpp:266-400): weighted rank statistic."""
+    name = "auc"
+    bigger_is_better = True
+
+    def eval(self, score, objective):
+        s = np.asarray(score[0] if score.ndim > 1 else score, np.float64)
+        y = (self.label > 0)
+        w = (self.weights if self.weights is not None
+             else np.ones_like(s))
+        order = np.argsort(s, kind="mergesort")
+        s_s, y_s, w_s = s[order], y[order], w[order]
+        # handle ties: average rank within equal-score groups
+        pos_w = np.where(y_s, w_s, 0.0)
+        neg_w = np.where(~y_s, w_s, 0.0)
+        cum_neg = np.cumsum(neg_w)
+        # group by unique scores
+        uniq, inv = np.unique(s_s, return_inverse=True)
+        grp_pos = np.bincount(inv, weights=pos_w)
+        grp_neg = np.bincount(inv, weights=neg_w)
+        cum_neg_before = np.concatenate([[0.0], np.cumsum(grp_neg)[:-1]])
+        auc_sum = np.sum(grp_pos * (cum_neg_before + 0.5 * grp_neg))
+        total_pos = pos_w.sum()
+        total_neg = neg_w.sum()
+        if total_pos == 0 or total_neg == 0:
+            return [(self.name, 1.0)]
+        return [(self.name, float(auc_sum / (total_pos * total_neg)))]
+
+
+# --- multiclass (src/metric/multiclass_metric.hpp) ------------------------
+
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective):
+        p = self._convert(score, objective)      # [K, N]
+        k = p.shape[0]
+        eps = 1e-15
+        y = self.label.astype(np.int64)
+        py = np.clip(p[y, np.arange(p.shape[1])], eps, None)
+        return [(self.name, self._avg(-np.log(py)))]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, score, objective):
+        p = self._convert(score, objective)
+        pred = np.argmax(p, axis=0)
+        y = self.label.astype(np.int64)
+        return [(self.name, self._avg((pred != y).astype(np.float64)))]
+
+
+class MultiSoftmaxLoglossMetric(MultiLoglossMetric):
+    name = "multi_logloss"
+
+
+# --- xentropy family (src/metric/xentropy_metric.hpp) ---------------------
+
+class CrossEntropyMetric(Metric):
+    name = "cross_entropy"
+
+    def eval(self, score, objective):
+        p = self._convert(score[0] if score.ndim > 1 else score, objective)
+        eps = 1e-15
+        p = np.clip(p, eps, 1.0 - eps)
+        y = self.label
+        loss = -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+        return [(self.name, self._avg(loss))]
+
+
+class CrossEntropyLambdaMetric(Metric):
+    name = "cross_entropy_lambda"
+
+    def eval(self, score, objective):
+        s = np.asarray(score[0] if score.ndim > 1 else score, np.float64)
+        # hhat = log(1 + exp(s)); loss per xentropy_metric.hpp
+        hhat = np.log1p(np.exp(s))
+        y = self.label
+        w = self.weights if self.weights is not None else 1.0
+        p = 1.0 - np.exp(-w * hhat)
+        eps = 1e-15
+        p = np.clip(p, eps, 1.0 - eps)
+        loss = -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+        return [(self.name, float(np.mean(loss)))]
+
+
+class KLDivergenceMetric(Metric):
+    name = "kldiv"
+
+    def eval(self, score, objective):
+        s = np.asarray(score[0] if score.ndim > 1 else score, np.float64)
+        p = 1.0 / (1.0 + np.exp(-s))
+        eps = 1e-15
+        p = np.clip(p, eps, 1.0 - eps)
+        y = np.clip(self.label, eps, 1.0 - eps)
+        kl = (y * np.log(y / p) + (1.0 - y) * np.log((1.0 - y) / (1.0 - p)))
+        return [(self.name, self._avg(kl))]
+
+
+# --- ranking (src/metric/rank_metric.hpp, map_metric.hpp) -----------------
+
+class NDCGMetric(Metric):
+    name = "ndcg"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.query_boundaries is None:
+            log.fatal("NDCG metric requires query information")
+        label_gain = self.config.label_gain
+        if not label_gain:
+            label_gain = [float(2 ** i - 1) for i in range(31)]
+        self.label_gain = np.asarray(label_gain, np.float64)
+        self.eval_at = list(self.config.eval_at) or [1, 2, 3, 4, 5]
+
+    def eval(self, score, objective):
+        s = np.asarray(score[0] if score.ndim > 1 else score, np.float64)
+        qb = self.query_boundaries
+        results = {k: [] for k in self.eval_at}
+        qweights = []
+        for q in range(len(qb) - 1):
+            lo, hi = qb[q], qb[q + 1]
+            ls = self.label[lo:hi].astype(np.int64)
+            ss = s[lo:hi]
+            qweights.append(1.0)
+            order = np.argsort(-ss, kind="mergesort")
+            gains = self.label_gain[ls]
+            ideal = np.sort(gains)[::-1]
+            disc = 1.0 / np.log2(np.arange(len(ls)) + 2.0)
+            for k in self.eval_at:
+                kk = min(k, len(ls))
+                dcg = np.sum(gains[order[:kk]] * disc[:kk])
+                maxdcg = np.sum(ideal[:kk] * disc[:kk])
+                results[k].append(1.0 if maxdcg <= 0 else dcg / maxdcg)
+        out = []
+        for k in self.eval_at:
+            out.append((f"ndcg@{k}", float(np.mean(results[k]))))
+        return out
+
+
+class MapMetric(Metric):
+    name = "map"
+    bigger_is_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.query_boundaries is None:
+            log.fatal("MAP metric requires query information")
+        self.eval_at = list(self.config.eval_at) or [1, 2, 3, 4, 5]
+
+    def eval(self, score, objective):
+        s = np.asarray(score[0] if score.ndim > 1 else score, np.float64)
+        qb = self.query_boundaries
+        results = {k: [] for k in self.eval_at}
+        for q in range(len(qb) - 1):
+            lo, hi = qb[q], qb[q + 1]
+            rel = self.label[lo:hi] > 0
+            order = np.argsort(-s[lo:hi], kind="mergesort")
+            rel_sorted = rel[order]
+            hits = np.cumsum(rel_sorted)
+            prec = hits / (np.arange(len(rel_sorted)) + 1.0)
+            for k in self.eval_at:
+                kk = min(k, len(rel_sorted))
+                num_rel = rel_sorted[:kk].sum()
+                ap = (np.sum(prec[:kk] * rel_sorted[:kk]) / num_rel
+                      if num_rel > 0 else 0.0)
+                results[k].append(ap)
+        return [(f"map@{k}", float(np.mean(results[k])))
+                for k in self.eval_at]
+
+
+# --- factory (src/metric/metric.cpp:11-55) --------------------------------
+
+_METRICS = {
+    "l2": L2Metric, "mean_squared_error": L2Metric, "mse": L2Metric,
+    "regression": L2Metric, "regression_l2": L2Metric,
+    "l2_root": RMSEMetric, "root_mean_squared_error": RMSEMetric,
+    "rmse": RMSEMetric,
+    "l1": L1Metric, "mean_absolute_error": L1Metric, "mae": L1Metric,
+    "regression_l1": L1Metric,
+    "quantile": QuantileMetric,
+    "huber": HuberLossMetric,
+    "fair": FairLossMetric,
+    "poisson": PoissonMetric,
+    "mape": MAPEMetric, "mean_absolute_percentage_error": MAPEMetric,
+    "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric, "binary": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "ndcg": NDCGMetric, "lambdarank": NDCGMetric,
+    "map": MapMetric, "mean_average_precision": MapMetric,
+    "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
+    "softmax": MultiLoglossMetric, "multiclassova": MultiLoglossMetric,
+    "multiclass_ova": MultiLoglossMetric, "ova": MultiLoglossMetric,
+    "ovr": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "cross_entropy": CrossEntropyMetric, "xentropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "xentlambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KLDivergenceMetric, "kldiv": KLDivergenceMetric,
+}
+
+
+def metric_alias(name: str) -> str:
+    n = name.strip().lower()
+    return _METRICS[n].name if n in _METRICS else n
+
+
+def create_metric(name: str, config) -> Optional[Metric]:
+    n = name.strip().lower()
+    if n in ("", "none", "null", "na", "custom"):
+        return None
+    if n.startswith("ndcg@") or n.startswith("map@"):
+        base, at = n.split("@", 1)
+        config.eval_at = [int(x) for x in at.split(",")]
+        n = base
+    if n not in _METRICS:
+        log.warning("Unknown metric %s", name)
+        return None
+    return _METRICS[n](config)
+
+
+def create_metrics(names: Sequence[str], config, metadata,
+                   num_data: int) -> List[Metric]:
+    out = []
+    seen = set()
+    for name in names:
+        m = create_metric(name, config)
+        if m is not None and m.name not in seen:
+            m.init(metadata, num_data)
+            seen.add(m.name)
+            out.append(m)
+    return out
+
+
+def default_metric_for_objective(objective_name: str) -> str:
+    """Config::GetMetricType fallback: metric defaults to objective."""
+    return objective_name

@@ -1,0 +1,573 @@
+"""GBDT boosting engine.
+
+TPU-native counterpart of the reference GBDT
+(reference: src/boosting/gbdt.{h,cpp}: Init gbdt.cpp:47, TrainOneIter
+gbdt.cpp:333-412, Bagging gbdt.cpp:182-243, UpdateScore gbdt.cpp:451,
+EvalAndCheckEarlyStopping gbdt.cpp:432, model text
+src/boosting/gbdt_model_text.cpp:240-540).
+
+Design: scores, gradients, bagging masks and the per-tree growth all stay
+on device; the host drives one jitted tree-build per (iteration, class)
+and keeps lightweight python Tree mirrors for serialization/prediction on
+raw features. Bagging uses a 0/1 device mask folded into the histogram
+weights (equivalent to the reference's index-subset bagging — histograms,
+counts and leaf sums see only bagged rows).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import TpuDataset
+from ..metrics import Metric
+from ..objectives import ObjectiveFunction
+from ..ops.grower import GrowerConfig, make_tree_grower
+from ..ops.predict import add_leaf_outputs, replay_partition
+from ..ops.split import SplitParams
+from ..utils import log
+from .tree import Tree, tree_from_record
+
+K_MODEL_VERSION = "v2"     # gbdt.h kModelVersion
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree driver (boosting.h:22 interface)."""
+
+    def __init__(self):
+        self.config: Optional[Config] = None
+        self.train_data: Optional[TpuDataset] = None
+        self.objective: Optional[ObjectiveFunction] = None
+        self.models: List[Tree] = []           # host trees, class-major order
+        self.records: List = []                # device TreeRecords (same order)
+        self.iter_ = 0
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.shrinkage_rate = 0.1
+        self.max_feature_idx = 0
+        self.label_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.average_output = False
+        self.valid_sets: List[TpuDataset] = []
+        self.valid_names: List[str] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.training_metrics: List[Metric] = []
+        self.best_score: Dict = {}
+        self.loaded_parameter = ""
+        self._grower = None
+
+    # -- init (gbdt.cpp:47-117) --------------------------------------------
+
+    def init(self, config: Config, train_data: TpuDataset,
+             objective: Optional[ObjectiveFunction],
+             training_metrics: Sequence[Metric] = ()):
+        self.config = config
+        self.train_data = train_data
+        self.objective = objective
+        self.training_metrics = list(training_metrics)
+        self.iter_ = 0
+        self.num_class = config.num_class
+        self.shrinkage_rate = config.learning_rate
+        self.num_tree_per_iteration = (
+            objective.num_model_per_iteration if objective else config.num_class)
+        self.max_feature_idx = train_data.num_total_features - 1
+        self.feature_names = list(train_data.feature_names)
+        self.feature_infos = train_data.feature_infos()
+
+        n = train_data.num_data
+        self._n = n
+        self._bins_dev = jnp.asarray(train_data.bins)
+        self._meta = train_data.feature_meta()
+        self._setup_grower()
+        self._init_scores()
+        self._bagging_rng = np.random.default_rng(config.bagging_seed)
+        self._feature_rng = np.random.default_rng(config.feature_fraction_seed)
+        self._label_np = (train_data.metadata.label
+                          if train_data.metadata.label is not None
+                          else np.zeros(n, np.float32))
+
+    def _setup_grower(self):
+        cfg = self.config
+        hp = SplitParams(
+            lambda_l1=cfg.lambda_l1, lambda_l2=cfg.lambda_l2,
+            max_delta_step=cfg.max_delta_step,
+            min_data_in_leaf=float(cfg.min_data_in_leaf),
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            min_gain_to_split=cfg.min_gain_to_split)
+        # depth cap: reference grows leaf-wise; max_depth bounds node depth
+        gcfg = GrowerConfig(
+            num_leaves=max(cfg.num_leaves, 2),
+            num_bins=self.train_data.max_bin_global,
+            max_depth=cfg.max_depth,
+            chunk=min(cfg.tpu_hist_chunk, _round_up(self._n, 128)),
+            hp=hp)
+        self._grower_cfg = gcfg
+        self._grower = make_tree_grower(gcfg, self._meta)
+
+    def _init_scores(self):
+        n, k = self._n, self.num_tree_per_iteration
+        init = np.zeros((k, n), np.float32)
+        self._boost_from_avg_done = [False] * k
+        md = self.train_data.metadata
+        if md.init_score is not None:
+            init += np.asarray(md.init_score, np.float32).reshape(k, n)
+        self._scores = jnp.asarray(init)
+        self._valid_scores: List[jax.Array] = []
+
+    def add_valid_data(self, valid_data: TpuDataset,
+                       metrics: Sequence[Metric], name: str = "") -> None:
+        self.valid_sets.append(valid_data)
+        self.valid_names.append(name or f"valid_{len(self.valid_sets)}")
+        self.valid_metrics.append(list(metrics))
+        k, nv = self.num_tree_per_iteration, valid_data.num_data
+        init = np.zeros((k, nv), np.float32)
+        if valid_data.metadata.init_score is not None:
+            init += np.asarray(valid_data.metadata.init_score,
+                               np.float32).reshape(k, nv)
+        self._valid_scores.append(jnp.asarray(init))
+        # replay existing model on the new valid set
+        vb = jnp.asarray(valid_data.bins)
+        for t_idx, rec in enumerate(self.records):
+            cls = t_idx % self.num_tree_per_iteration
+            leaf = replay_partition(rec, vb, self._meta)
+            self._valid_scores[-1] = self._valid_scores[-1].at[cls].set(
+                add_leaf_outputs(self._valid_scores[-1][cls], leaf,
+                                 rec.leaf_output, 1.0))
+
+    # -- bagging (gbdt.cpp:161-243) -----------------------------------------
+
+    def _bagging_mask(self, iteration: int) -> Optional[np.ndarray]:
+        cfg = self.config
+        if not (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0):
+            return None
+        if iteration % cfg.bagging_freq != 0 and hasattr(self, "_bag_cache"):
+            return self._bag_cache
+        n = self._n
+        cnt = int(n * cfg.bagging_fraction)
+        idx = self._bagging_rng.choice(n, cnt, replace=False)
+        mask = np.zeros(n, np.float32)
+        mask[idx] = 1.0
+        self._bag_cache = mask
+        return mask
+
+    def _feature_mask(self) -> np.ndarray:
+        cfg = self.config
+        f = self.train_data.num_features
+        mask = np.ones(f, bool)
+        if cfg.feature_fraction < 1.0:
+            used = max(1, int(f * cfg.feature_fraction))
+            sel = self._feature_rng.choice(f, used, replace=False)
+            mask = np.zeros(f, bool)
+            mask[sel] = True
+        return mask
+
+    # -- boosting (gbdt.cpp:333-412) ----------------------------------------
+
+    def boost_from_average(self, class_id: int) -> float:
+        """BoostFromAverage (gbdt.cpp:311-330): only when the model is
+        still empty and no init score was supplied."""
+        cfg = self.config
+        if (self.models or not cfg.boost_from_average
+                or self.objective is None
+                or self.train_data.metadata.init_score is not None):
+            return 0.0
+        name = self.objective.name
+        if name in ("regression", "regression_l1", "quantile", "huber",
+                    "fair", "mape", "binary", "cross_entropy"):
+            init = self.objective.boost_from_score(class_id)
+            if init != 0.0:
+                self._scores = self._scores.at[class_id].add(init)
+                for i in range(len(self._valid_scores)):
+                    self._valid_scores[i] = \
+                        self._valid_scores[i].at[class_id].add(init)
+                log.info("Start training from score %g", init)
+            return init
+        if name in ("poisson", "gamma", "tweedie"):
+            init = self.objective.boost_from_score(class_id)
+            if init != 0.0:
+                self._scores = self._scores.at[class_id].add(init)
+                for i in range(len(self._valid_scores)):
+                    self._valid_scores[i] = \
+                        self._valid_scores[i].at[class_id].add(init)
+                log.info("Start training from score %g", init)
+            return init
+        return 0.0
+
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration; returns True if training should stop
+        (gbdt.cpp:333-412). grad/hess: optional custom [K, N] arrays.
+
+        Stored TreeRecords are MODEL-equivalent: their ``leaf_output``
+        already carries shrinkage and (for the first iteration) the
+        boost-from-average bias, exactly like the reference's
+        ``Shrinkage`` + ``AddBias`` on the saved tree (gbdt.cpp:371-377).
+        """
+        init_scores = [0.0] * self.num_tree_per_iteration
+        if grad is None or hess is None:
+            if self.objective is None:
+                log.fatal("No objective; pass custom grad/hess")
+            for k in range(self.num_tree_per_iteration):
+                init_scores[k] = self.boost_from_average(k)
+            g_all, h_all = self.objective.get_gradients(self._scores
+                if self.num_tree_per_iteration > 1 else self._scores[0])
+            if self.num_tree_per_iteration == 1:
+                g_all, h_all = g_all[None, :], h_all[None, :]
+        else:
+            g_all = jnp.asarray(grad, jnp.float32).reshape(
+                self.num_tree_per_iteration, self._n)
+            h_all = jnp.asarray(hess, jnp.float32).reshape(
+                self.num_tree_per_iteration, self._n)
+
+        mask_np = self._bagging_mask(self.iter_)
+        mask = (jnp.ones(self._n, jnp.float32) if mask_np is None
+                else jnp.asarray(mask_np))
+        fmask = jnp.asarray(self._feature_mask())
+
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            rec, leaf_ids = self._grower(self._bins_dev, g_all[k], h_all[k],
+                                         mask, fmask)
+            nl = int(rec.num_leaves)
+            if nl > 1:
+                should_continue = True
+                rec = self._renew_tree_output(rec, k, leaf_ids)
+                # fold shrinkage into outputs (Tree::Shrinkage)
+                shrunk = rec.leaf_output * self.shrinkage_rate
+                rec = rec._replace(
+                    leaf_output=shrunk,
+                    internal_value=rec.internal_value * self.shrinkage_rate)
+                self._scores = self._scores.at[k].set(add_leaf_outputs(
+                    self._scores[k], leaf_ids, rec.leaf_output, 1.0))
+                # out-of-bag rows included: the partition covers ALL rows.
+                for vi, vset in enumerate(self.valid_sets):
+                    vb = jnp.asarray(vset.bins)
+                    vleaf = replay_partition(rec, vb, self._meta)
+                    self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
+                        add_leaf_outputs(self._valid_scores[vi][k], vleaf,
+                                         rec.leaf_output, 1.0))
+                shrinkage_for_file = self.shrinkage_rate
+                if abs(init_scores[k]) > 1e-15:
+                    # AddBias folds the init into the saved model (tree.h:151)
+                    rec = rec._replace(
+                        leaf_output=rec.leaf_output + init_scores[k],
+                        internal_value=rec.internal_value + init_scores[k])
+                    shrinkage_for_file = 1.0
+                tree = tree_from_record(
+                    rec, self.train_data.mappers,
+                    self.train_data.used_feature_map,
+                    1.0, self._grower_cfg.num_leaves)
+                tree.shrinkage = shrinkage_for_file
+                self.models.append(tree)
+                self.records.append(rec)
+            else:
+                # constant tree on the first iteration (gbdt.cpp:378-396)
+                if len(self.models) < self.num_tree_per_iteration:
+                    output = init_scores[k]
+                    if output == 0.0 and self.objective is not None:
+                        output = 0.0
+                    rec = rec._replace(
+                        leaf_output=jnp.zeros_like(rec.leaf_output)
+                        .at[0].set(output))
+                    if output != 0.0:
+                        self._scores = self._scores.at[k].add(output)
+                        for vi in range(len(self._valid_scores)):
+                            self._valid_scores[vi] = \
+                                self._valid_scores[vi].at[k].add(output)
+                    tree = tree_from_record(
+                        rec, self.train_data.mappers,
+                        self.train_data.used_feature_map, 1.0,
+                        self._grower_cfg.num_leaves)
+                    self.models.append(tree)
+                    self.records.append(rec)
+                else:
+                    self.models.append(Tree(2))
+                    self.records.append(rec._replace(
+                        leaf_output=jnp.zeros_like(rec.leaf_output)))
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > self.num_tree_per_iteration:
+                for _ in range(self.num_tree_per_iteration):
+                    self.models.pop()
+                    self.records.pop()
+            return True
+        self.iter_ += 1
+        return False
+
+    def _renew_tree_output(self, rec, class_id, leaf_ids):
+        """Objective-driven leaf refit (serial_tree_learner.cpp:780-818):
+        L1/quantile/MAPE replace leaf outputs with residual percentiles."""
+        obj = self.objective
+        if obj is None or not obj.is_renew_tree_output():
+            return rec
+        alpha = obj.renew_tree_output_percentile()
+        leaf_np = np.asarray(leaf_ids)
+        score_np = np.asarray(self._scores[class_id])
+        label = obj.trans_label if hasattr(obj, "trans_label") else obj.label
+        residual = label - score_np
+        w = getattr(obj, "label_weight", None)
+        if w is None:
+            w = obj.weights
+        outputs = np.asarray(rec.leaf_output).copy()
+        nl = int(rec.num_leaves)
+        from ..objectives.objective import _weighted_percentile
+        for leaf in range(nl):
+            in_leaf = leaf_np == leaf
+            if not in_leaf.any():
+                continue
+            res = residual[in_leaf]
+            ww = None if w is None else np.asarray(w)[in_leaf]
+            outputs[leaf] = _weighted_percentile(res, ww, alpha)
+        return rec._replace(leaf_output=jnp.asarray(outputs))
+
+    def rollback_one_iter(self) -> None:
+        """RollbackOneIter (gbdt.cpp:414-430)."""
+        if self.iter_ <= 0:
+            return
+        for k in range(self.num_tree_per_iteration - 1, -1, -1):
+            rec = self.records.pop()
+            self.models.pop()
+            # subtract scores
+            leaf = replay_partition(rec, self._bins_dev, self._meta)
+            self._scores = self._scores.at[k].set(add_leaf_outputs(
+                self._scores[k], leaf, rec.leaf_output, -1.0))
+            for vi, vset in enumerate(self.valid_sets):
+                vb = jnp.asarray(vset.bins)
+                vleaf = replay_partition(rec, vb, self._meta)
+                self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
+                    add_leaf_outputs(self._valid_scores[vi][k], vleaf,
+                                     rec.leaf_output, -1.0))
+        self.iter_ -= 1
+
+    # -- evaluation (gbdt.cpp:432-534) --------------------------------------
+
+    def get_eval_at(self, data_idx: int) -> List[tuple]:
+        """Returns [(metric_name, value, bigger_better)] for dataset
+        data_idx (0 = train, 1.. = valid)."""
+        out = []
+        if data_idx == 0:
+            scores = self._scores
+            metrics = self.training_metrics
+        else:
+            scores = self._valid_scores[data_idx - 1]
+            metrics = self.valid_metrics[data_idx - 1]
+        raw = np.asarray(scores)
+        for m in metrics:
+            for name, val in m.eval(raw, self.objective):
+                out.append((name, val, m.bigger_is_better))
+        return out
+
+    # -- prediction ---------------------------------------------------------
+
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
+                    start_iteration: int = 0) -> np.ndarray:
+        """Raw scores [N] or [N, K]. Device path: bin with train mappers,
+        replay trees (gbdt_prediction.cpp:9-30)."""
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        ntree = len(self.models)
+        if num_iteration >= 0:
+            ntree = min(ntree, (start_iteration + num_iteration) * k)
+        bins = self._bin_input(X)
+        bins_dev = jnp.asarray(bins)
+        out = np.zeros((k, n), np.float64)
+        for t_idx in range(start_iteration * k, ntree):
+            rec = self.records[t_idx] if t_idx < len(self.records) else None
+            cls = t_idx % k
+            if rec is not None:
+                leaf = replay_partition(rec, bins_dev, self._meta)
+                out[cls] += np.asarray(rec.leaf_output)[np.asarray(leaf)]
+            else:
+                out[cls] += self.models[t_idx].predict(X)
+        if self.average_output and self.iter_ > 0:
+            out /= self.iter_
+        return out[0] if k == 1 else out.T
+
+    def _bin_input(self, X: np.ndarray) -> np.ndarray:
+        ds = self.train_data
+        f = max(ds.num_features, 1)
+        dtype = np.uint8 if ds.max_bin_global <= 256 else np.int32
+        bins = np.zeros((X.shape[0], f), dtype)
+        for i, real in enumerate(ds.used_feature_map):
+            bins[:, i] = ds.mappers[i].value_to_bin(X[:, real]).astype(dtype)
+        return bins
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration)
+        if self.objective is not None:
+            return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+        return raw
+
+    def predict_leaf_index(self, X: np.ndarray,
+                           num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        ntree = len(self.models)
+        if num_iteration >= 0:
+            ntree = min(ntree, num_iteration * self.num_tree_per_iteration)
+        out = np.zeros((X.shape[0], ntree), np.int32)
+        for t in range(ntree):
+            out[:, t] = self.models[t].predict_leaf_index(X)
+        return out
+
+    # -- feature importance (gbdt.cpp FeatureImportance) ---------------------
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = 0) -> np.ndarray:
+        n_models = len(self.models)
+        if iteration > 0:
+            n_models = min(n_models, iteration * self.num_tree_per_iteration)
+        imp = np.zeros(self.max_feature_idx + 1, np.float64)
+        for t in self.models[:n_models]:
+            for i in range(t.num_leaves - 1):
+                if importance_type == "split":
+                    imp[t.split_feature[i]] += 1.0
+                else:
+                    imp[t.split_feature[i]] += max(t.split_gain[i], 0.0)
+        return imp
+
+    # -- model text serialization (gbdt_model_text.cpp:240-338) --------------
+
+    def model_to_string(self, start_iteration: int = 0,
+                        num_iteration: int = -1) -> str:
+        lines = ["tree"]
+        lines.append(f"version={K_MODEL_VERSION}")
+        lines.append(f"num_class={self.num_class}")
+        lines.append(f"num_tree_per_iteration={self.num_tree_per_iteration}")
+        lines.append(f"label_index={self.label_idx}")
+        lines.append(f"max_feature_idx={self.max_feature_idx}")
+        if self.objective is not None:
+            lines.append(f"objective={self.objective.to_string()}")
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(self.feature_names))
+        lines.append("feature_infos=" + " ".join(self.feature_infos))
+
+        total_iter = len(self.models) // max(self.num_tree_per_iteration, 1)
+        start_iteration = max(0, min(start_iteration, total_iter))
+        num_used = len(self.models)
+        if num_iteration > 0:
+            num_used = min((start_iteration + num_iteration)
+                           * self.num_tree_per_iteration, num_used)
+        start_model = start_iteration * self.num_tree_per_iteration
+
+        tree_strs = []
+        for i in range(start_model, num_used):
+            s = f"Tree={i - start_model}\n" + self.models[i].to_string() + "\n"
+            tree_strs.append(s)
+        lines.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+        lines.append("")
+        body = "\n".join(lines) + "\n" + "".join(tree_strs)
+        body += "end of trees\n"
+
+        imp = self.feature_importance("split")
+        pairs = [(int(imp[i]), self.feature_names[i])
+                 for i in range(len(imp)) if imp[i] > 0]
+        pairs.sort(key=lambda p: -p[0])
+        body += "\nfeature importances:\n"
+        for v, name in pairs:
+            body += f"{name}={v}\n"
+        if self.config is not None:
+            body += "\nparameters:\n" + self.config.to_string() + "\n"
+            body += "end of parameters\n"
+        elif self.loaded_parameter:
+            body += "\nparameters:\n" + self.loaded_parameter + "\n"
+            body += "end of parameters\n"
+        return body
+
+    def save_model_to_file(self, filename: str, start_iteration: int = 0,
+                           num_iteration: int = -1) -> None:
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(start_iteration, num_iteration))
+
+    def load_model_from_string(self, s: str) -> "GBDT":
+        """LoadModelFromString (gbdt_model_text.cpp:339-450)."""
+        from ..objectives import parse_objective_from_model_string
+        lines = s.splitlines()
+        kv = {}
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            if line.startswith("Tree="):
+                break
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k] = v
+            elif line == "average_output":
+                kv["average_output"] = "1"
+            i += 1
+        self.num_class = int(kv.get("num_class", 1))
+        self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
+        self.label_idx = int(kv.get("label_index", 0))
+        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        self.average_output = "average_output" in kv
+        self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos = kv.get("feature_infos", "").split()
+        if self.config is None:
+            self.config = Config()
+        if "objective" in kv:
+            self.objective = parse_objective_from_model_string(
+                kv["objective"], self.config)
+            if self.objective is not None:
+                # objective usable only for convert_output after load
+                self.objective.label = np.zeros(1, np.float32)
+                self.objective.weights = None
+                self.objective.num_data = 1
+        # parse trees
+        self.models = []
+        self.records = []
+        cur: List[str] = []
+        for line in lines[i:]:
+            t = line.strip()
+            if t.startswith("Tree=") or t == "end of trees":
+                if cur:
+                    self.models.append(Tree.from_string("\n".join(cur)))
+                    cur = []
+                if t == "end of trees":
+                    break
+            elif t:
+                cur.append(t)
+        self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
+        self.shrinkage_rate = 1.0  # already folded into leaf values
+        return self
+
+    def dump_model(self, start_iteration: int = 0,
+                   num_iteration: int = -1) -> dict:
+        """DumpModel JSON (gbdt_model_text.cpp:15-54)."""
+        num_used = len(self.models)
+        if num_iteration > 0:
+            num_used = min((start_iteration + num_iteration)
+                           * self.num_tree_per_iteration, num_used)
+        start_model = start_iteration * self.num_tree_per_iteration
+        return {
+            "name": "tree",
+            "version": K_MODEL_VERSION,
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_idx,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": (self.objective.to_string()
+                          if self.objective else "none"),
+            "average_output": self.average_output,
+            "feature_names": self.feature_names,
+            "tree_info": [t.to_json()
+                          for t in self.models[start_model:num_used]],
+        }
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

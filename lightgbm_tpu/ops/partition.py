@@ -1,0 +1,44 @@
+"""Leaf-membership partition updates.
+
+TPU-native counterpart of DataPartition::Split + Bin::Split
+(reference: src/treelearner/data_partition.hpp:109-166,
+src/io/dense_bin.hpp Split). The reference maintains a permutation array
+with per-leaf (begin, count) ranges; on TPU we keep a flat ``leaf_ids[N]``
+assignment updated by a masked elementwise select — shape-static, no
+host round trip, and directly usable to scatter leaf outputs into the
+score vector.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .split import MISSING_NAN, MISSING_ZERO
+
+
+def row_goes_right(bin_col, threshold_bin, default_left, missing_type,
+                   default_bin, num_bin):
+    """Binned decision for one split (dense_bin.hpp Split semantics).
+
+    - missing NaN  -> rows in the NaN bin (num_bin-1) go to the default side
+    - missing Zero -> rows in the default(zero) bin go to the default side
+    - otherwise    -> bin <= threshold goes left
+    """
+    is_missing = (((missing_type == MISSING_NAN) & (bin_col == num_bin - 1))
+                  | ((missing_type == MISSING_ZERO) & (bin_col == default_bin)))
+    base_right = bin_col > threshold_bin
+    return jnp.where(is_missing, ~default_left, base_right)
+
+
+def apply_split(leaf_ids, bin_col, leaf, new_leaf, threshold_bin,
+                default_left, missing_type, default_bin, num_bin,
+                enabled=True):
+    """Send the split leaf's right-side rows to ``new_leaf``.
+
+    Left child keeps the parent's leaf index, right child takes the new
+    index — matching Tree::Split leaf numbering (src/io/tree.cpp: left
+    keeps ``leaf``, right becomes ``num_leaves_``).
+    """
+    right = row_goes_right(bin_col, threshold_bin, default_left,
+                           missing_type, default_bin, num_bin)
+    move = (leaf_ids == leaf) & right & enabled
+    return jnp.where(move, new_leaf, leaf_ids)

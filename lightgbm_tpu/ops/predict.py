@@ -1,0 +1,57 @@
+"""On-device prediction over binned data.
+
+Counterpart of the reference's score updating and tree prediction
+(reference: src/boosting/score_updater.hpp:17-123, src/io/tree.h:212-266).
+Scores for train/valid sets are maintained entirely on device: a tree's
+splits are replayed over the binned matrix (same order and leaf numbering
+as growth, so the assignment is identical to the grower's partition), then
+leaf outputs are gathered into the score vector.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .partition import apply_split
+from .split import FeatureMeta
+
+
+def replay_partition(rec, bins, meta: FeatureMeta):
+    """Assign each row of ``bins`` [N, F] to a leaf of the recorded tree by
+    replaying its splits (Tree numbering: split i's right child = leaf i+1).
+    """
+    meta = FeatureMeta(*[jnp.asarray(x) for x in meta])
+    n = bins.shape[0]
+    num_splits = rec.split_leaf.shape[0]
+    leaf_ids = jnp.zeros(n, jnp.int32)
+
+    def body(i, leaf_ids):
+        feat = rec.split_feature[i]
+        enabled = rec.split_leaf[i] >= 0
+        safe_feat = jnp.maximum(feat, 0)
+        bin_col = jnp.take(bins, safe_feat, axis=1).astype(jnp.int32)
+        return apply_split(
+            leaf_ids, bin_col, rec.split_leaf[i], i + 1, rec.split_bin[i],
+            rec.split_default_left[i], meta.missing_type[safe_feat],
+            meta.default_bin[safe_feat], meta.num_bin[safe_feat],
+            enabled=enabled)
+
+    return jax.lax.fori_loop(0, num_splits, body, leaf_ids)
+
+
+@jax.jit
+def add_leaf_outputs(scores, leaf_ids, leaf_output, shrinkage):
+    """score += shrinkage * leaf_output[leaf] (ScoreUpdater::AddScore)."""
+    return scores + shrinkage * leaf_output[leaf_ids]
+
+
+def predict_trees_binned(records, bins, meta: FeatureMeta, shrinkage_done=True):
+    """Sum of leaf outputs over a list of TreeRecords for binned rows."""
+    n = bins.shape[0]
+    out = jnp.zeros(n, jnp.float32)
+    for rec in records:
+        leaf = replay_partition(rec, bins, meta)
+        out = out + rec.leaf_output[leaf]
+    return out

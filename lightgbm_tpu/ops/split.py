@@ -1,0 +1,254 @@
+"""Vectorized best-split search over histograms.
+
+TPU-native counterpart of FeatureHistogram::FindBestThreshold*
+(reference: src/treelearner/feature_histogram.hpp:76-653). The reference
+scans each feature's bins twice (right-to-left with missing-default-left,
+left-to-right with missing-default-right); here both scans over every
+feature are evaluated at once as cumulative sums + masked argmax — an
+ideal XLA workload (no data-dependent control flow).
+
+Semantics preserved from the reference:
+- L1-thresholded leaf outputs and gains (ThresholdL1 /
+  CalculateSplittedLeafOutput / GetLeafSplitGainGivenOutput,
+  feature_histogram.hpp:442-504).
+- kEpsilon hessian regularization on each accumulated side and
+  ``sum_hessian + 2*kEpsilon`` at the parent (feature_histogram.hpp:76-80).
+- Missing handling: two-direction scans when ``num_bin > 2`` and missing
+  is not None; NaN bin excluded from accumulation (rides with the default
+  side); zero(default)-bin skipped when missing type is Zero
+  (feature_histogram.hpp:87-110,506-653).
+- min_data_in_leaf / min_sum_hessian_in_leaf / min_gain_to_split gates and
+  monotone-constraint zeroing (GetSplitGains, feature_histogram.hpp:458).
+- Tie-breaking: the flattened argmax order reproduces the reference's
+  scan order (feature-major; dir=-1 before dir=+1; within dir=-1 larger
+  thresholds win, within dir=+1 smaller thresholds win).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+KEPSILON = 1e-15            # meta.h:38
+KMIN_SCORE = -jnp.inf
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class SplitParams(NamedTuple):
+    """Static (per-training-run) split hyperparameters."""
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    max_delta_step: float = 0.0
+    min_data_in_leaf: float = 20.0
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+
+
+class FeatureMeta(NamedTuple):
+    """Per-feature bin metadata as device arrays (host numpy accepted)."""
+    num_bin: jax.Array       # [F] int32
+    missing_type: jax.Array  # [F] int32
+    default_bin: jax.Array   # [F] int32
+    monotone: jax.Array      # [F] int32 (-1, 0, +1)
+    penalty: jax.Array       # [F] float32 (feature_contri; 1.0 default)
+
+    @classmethod
+    def from_mappers(cls, mappers, monotone_constraints=None,
+                     feature_contri=None) -> "FeatureMeta":
+        f = len(mappers)
+        mono = np.zeros(f, np.int32)
+        if monotone_constraints:
+            mono[:len(monotone_constraints)] = monotone_constraints
+        pen = np.ones(f, np.float32)
+        if feature_contri:
+            pen[:len(feature_contri)] = feature_contri
+        return cls(
+            num_bin=np.array([m.num_bin for m in mappers], np.int32),
+            missing_type=np.array([m.missing_type for m in mappers], np.int32),
+            default_bin=np.array([m.default_bin for m in mappers], np.int32),
+            monotone=mono,
+            penalty=pen,
+        )
+
+
+class SplitResult(NamedTuple):
+    """Best split for one leaf — all scalars (SplitInfo analog,
+    src/treelearner/split_info.hpp:17)."""
+    gain: jax.Array
+    feature: jax.Array
+    threshold_bin: jax.Array
+    default_left: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+    left_count: jax.Array
+    right_count: jax.Array
+    left_sum_g: jax.Array
+    left_sum_h: jax.Array
+    right_sum_g: jax.Array
+    right_sum_h: jax.Array
+
+
+def threshold_l1(s, l1):
+    """ThresholdL1 (feature_histogram.hpp:442)."""
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def calculate_leaf_output(sum_g, sum_h, l1, l2, max_delta_step):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:447)."""
+    ret = -threshold_l1(sum_g, l1) / (sum_h + l2)
+    if max_delta_step > 0.0:
+        ret = jnp.clip(ret, -max_delta_step, max_delta_step)
+    return ret
+
+
+def leaf_split_gain_given_output(sum_g, sum_h, l1, l2, output):
+    """GetLeafSplitGainGivenOutput (feature_histogram.hpp:500)."""
+    sg_l1 = threshold_l1(sum_g, l1)
+    return -(2.0 * sg_l1 * output + (sum_h + l2) * output * output)
+
+
+def leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step):
+    """GetLeafSplitGain (feature_histogram.hpp:495)."""
+    out = calculate_leaf_output(sum_g, sum_h, l1, l2, max_delta_step)
+    return leaf_split_gain_given_output(sum_g, sum_h, l1, l2, out)
+
+
+def find_best_split(hist: jax.Array, sum_g, sum_h, num_data,
+                    feature_mask: jax.Array, meta: FeatureMeta,
+                    hp: SplitParams, can_split=True) -> SplitResult:
+    """Find the best (feature, threshold, direction) for one leaf.
+
+    Args:
+      hist: [F, B, 3] histogram (grad, hess, count).
+      sum_g/sum_h/num_data: leaf totals (scalars; num_data = bagged count).
+      feature_mask: [F] bool — usable features (feature_fraction sampling,
+        trivial-feature exclusion).
+      can_split: scalar bool gate (e.g. max_depth reached) — forces -inf gain.
+    """
+    f32 = jnp.float32
+    F, B, _ = hist.shape
+    nb = meta.num_bin.astype(jnp.int32)            # [F]
+    mt = meta.missing_type.astype(jnp.int32)       # [F]
+    db = meta.default_bin.astype(jnp.int32)        # [F]
+    mono = meta.monotone.astype(jnp.int32)         # [F]
+
+    l1 = f32(hp.lambda_l1)
+    l2 = f32(hp.lambda_l2)
+    mds = float(hp.max_delta_step)
+
+    sum_g = jnp.asarray(sum_g, f32)
+    sum_h2 = jnp.asarray(sum_h, f32) + 2.0 * KEPSILON   # hpp:80
+    num_data = jnp.asarray(num_data, f32)
+
+    gain_shift = leaf_split_gain(sum_g, sum_h2, l1, l2, mds)
+    min_gain_shift = gain_shift + f32(hp.min_gain_to_split)
+
+    bidx = jnp.arange(B, dtype=jnp.int32)[None, :]  # [1, B]
+    nb_c = nb[:, None]
+    two_scan = (nb > 2) & (mt != MISSING_NONE)      # [F]
+    use_na = two_scan & (mt == MISSING_NAN)
+    skip_db = two_scan & (mt == MISSING_ZERO)
+
+    # --- contributions entering the cumulative scans --------------------
+    valid_bin = bidx < nb_c
+    zero_bin = (skip_db[:, None] & (bidx == db[:, None]))
+    nan_bin = (use_na[:, None] & (bidx == nb_c - 1))
+    contrib_mask = (valid_bin & ~zero_bin & ~nan_bin).astype(f32)  # [F, B]
+    contrib = hist * contrib_mask[:, :, None]                      # [F, B, 3]
+
+    cum = jnp.cumsum(contrib, axis=1)               # [F, B, 3] prefix sums
+    tot = cum[:, -1, :]                             # [F, 3]
+
+    # --- dir = +1 : left accumulates from bin 0 (default right) ---------
+    l_g1 = cum[:, :, 0]
+    l_h1 = cum[:, :, 1] + KEPSILON
+    l_c1 = cum[:, :, 2]
+    r_g1 = sum_g - l_g1
+    r_h1 = sum_h2 - l_h1
+    r_c1 = num_data - l_c1
+    valid1 = (two_scan[:, None]
+              & (bidx <= nb_c - 2)
+              & ~(skip_db[:, None] & (bidx == db[:, None])))
+
+    # --- dir = -1 : right accumulates from the top (default left) ------
+    r_g2 = tot[:, None, 0] - cum[:, :, 0]
+    r_h2 = tot[:, None, 1] - cum[:, :, 1] + KEPSILON
+    r_c2 = tot[:, None, 2] - cum[:, :, 2]
+    l_g2 = sum_g - r_g2
+    l_h2 = sum_h2 - r_h2
+    l_c2 = num_data - r_c2
+    max_t2 = jnp.where(use_na, nb - 3, nb - 2)[:, None]  # dir=-1 can't emit nb-2
+    valid2 = ((bidx <= max_t2)
+              & (bidx >= 0)
+              & ~(skip_db[:, None] & (bidx == db[:, None] - 1)))
+
+    def side_gains(lg, lh, rg, rh):
+        lo = calculate_leaf_output(lg, lh, l1, l2, mds)
+        ro = calculate_leaf_output(rg, rh, l1, l2, mds)
+        bad_mono = (((mono[:, None] > 0) & (lo > ro))
+                    | ((mono[:, None] < 0) & (lo < ro)))
+        g = (leaf_split_gain_given_output(lg, lh, l1, l2, lo)
+             + leaf_split_gain_given_output(rg, rh, l1, l2, ro))
+        return jnp.where(bad_mono, 0.0, g)
+
+    def constraints(lc, lh, rc, rh):
+        return ((lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf)
+                & (lh >= hp.min_sum_hessian_in_leaf)
+                & (rh >= hp.min_sum_hessian_in_leaf))
+
+    gains1 = side_gains(l_g1, l_h1, r_g1, r_h1)
+    ok1 = valid1 & constraints(l_c1, l_h1, r_c1, r_h1) & (gains1 > min_gain_shift)
+    gains2 = side_gains(l_g2, l_h2, r_g2, r_h2)
+    ok2 = valid2 & constraints(l_c2, l_h2, r_c2, r_h2) & (gains2 > min_gain_shift)
+
+    fmask = feature_mask[:, None] & can_split
+    g1 = jnp.where(ok1 & fmask, gains1, KMIN_SCORE)
+    g2 = jnp.where(ok2 & fmask, gains2, KMIN_SCORE)
+
+    # --- argmax with reference tie-break order --------------------------
+    # flatten [F, 2, B]: dir=-1 first with REVERSED thresholds (so larger t
+    # wins ties), then dir=+1 ascending. argmax returns first max.
+    cand = jnp.stack([g2[:, ::-1], g1], axis=1)     # [F, 2, B]
+    flat = cand.reshape(-1)
+    idx = jnp.argmax(flat)
+    best_gain = flat[idx]
+    fi = idx // (2 * B)
+    rem = idx % (2 * B)
+    d = rem // B                                     # 0 -> dir=-1, 1 -> dir=+1
+    tb = rem % B
+    t = jnp.where(d == 0, B - 1 - tb, tb)            # undo reversal
+
+    is_dir2 = d == 0
+    lg = jnp.where(is_dir2, l_g2[fi, t], l_g1[fi, t])
+    lh = jnp.where(is_dir2, l_h2[fi, t], l_h1[fi, t])
+    lc = jnp.where(is_dir2, l_c2[fi, t], l_c1[fi, t])
+    rg = sum_g - lg
+    rh = sum_h2 - lh
+    rc = num_data - lc
+
+    # single-scan NaN edge: report default_left = False (hpp:103-106)
+    single_nan = (~two_scan[fi]) & (mt[fi] == MISSING_NAN)
+    default_left = is_dir2 & ~single_nan
+
+    has = jnp.isfinite(best_gain)
+    out = SplitResult(
+        gain=jnp.where(has, best_gain - min_gain_shift, KMIN_SCORE)
+             * meta.penalty[fi],
+        feature=jnp.where(has, fi, -1).astype(jnp.int32),
+        threshold_bin=jnp.where(has, t, 0).astype(jnp.int32),
+        default_left=default_left & has,
+        left_output=calculate_leaf_output(lg, lh, l1, l2, mds),
+        right_output=calculate_leaf_output(rg, rh, l1, l2, mds),
+        left_count=lc,
+        right_count=rc,
+        left_sum_g=lg,
+        left_sum_h=lh - KEPSILON,    # hpp: stores sum - kEpsilon
+        right_sum_g=rg,
+        right_sum_h=rh - KEPSILON,
+    )
+    return out
