@@ -255,6 +255,10 @@ class Config:
     tpu_use_dp: bool = True          # fp32 (True) vs bf16 (False) hist accumulation
     tpu_hist_chunk: int = 16384      # rows per on-device histogram chunk
     tpu_donate_buffers: bool = True
+    # iterations between host checks for the "no more splits" stop
+    # (gbdt.cpp:393-409); device→host reads are high-latency, so the stop
+    # is detected periodically instead of every iteration
+    tpu_stop_check_interval: int = 8
 
     def __post_init__(self):
         self._raw_params: Dict[str, str] = {}

@@ -12,6 +12,18 @@ and keeps lightweight python Tree mirrors for serialization/prediction on
 raw features. Bagging uses a 0/1 device mask folded into the histogram
 weights (equivalent to the reference's index-subset bagging — histograms,
 counts and leaf sums see only bagged rows).
+
+The training loop performs ZERO device→host transfers per iteration:
+TreeRecords stay on device, host Tree mirrors are materialized lazily
+from ONE packed stacked download (pack_record), and the reference's
+"no more leaves to split" stop (gbdt.cpp:393-409) is detected by a
+periodic check every ``tpu_stop_check_interval`` iterations plus
+``finish_training()`` after the boosting loop; serialization
+independently caps at the first splitless iteration so mid-training
+checkpoints stay reference-equivalent. This matters doubly on TPU: each
+host transfer is a high-latency RPC, and the reference's own GPU path
+had the same host-roundtrip problem (gpu_tree_learner.cpp:891-1073
+hides it with async copies; we remove the transfers instead).
 """
 from __future__ import annotations
 
@@ -26,7 +38,8 @@ from ..config import Config
 from ..io.dataset import TpuDataset
 from ..metrics import Metric
 from ..objectives import ObjectiveFunction
-from ..ops.grower import GrowerConfig, make_tree_grower
+from ..ops.grower import (GrowerConfig, make_tree_grower, pack_record,
+                          unpack_record)
 from ..ops.predict import add_leaf_outputs, replay_partition
 from ..ops.split import SplitParams
 from ..utils import log
@@ -42,8 +55,11 @@ class GBDT:
         self.config: Optional[Config] = None
         self.train_data: Optional[TpuDataset] = None
         self.objective: Optional[ObjectiveFunction] = None
-        self.models: List[Tree] = []           # host trees, class-major order
+        # host trees, class-major order; None = not yet materialized from
+        # the device record (lazily built, see _ensure_host_trees)
+        self.models: List[Optional[Tree]] = []
         self.records: List = []                # device TreeRecords (same order)
+        self._tree_shrinkage: List[float] = []  # per-tree file shrinkage
         self.iter_ = 0
         self.num_class = 1
         self.num_tree_per_iteration = 1
@@ -90,6 +106,12 @@ class GBDT:
         self._label_np = (train_data.metadata.label
                           if train_data.metadata.label is not None
                           else np.zeros(n, np.float32))
+        self._valid_bins_dev: List[jax.Array] = []
+        self._stop_check_interval = max(1, config.tpu_stop_check_interval)
+        self._stopped = False
+        # number of leading iteration-groups already verified productive,
+        # so each periodic stop check scans only the new tail
+        self._clean_groups = 0
 
     def _setup_grower(self):
         cfg = self.config
@@ -130,8 +152,10 @@ class GBDT:
             init += np.asarray(valid_data.metadata.init_score,
                                np.float32).reshape(k, nv)
         self._valid_scores.append(jnp.asarray(init))
-        # replay existing model on the new valid set
+        # replay existing model on the new valid set (bins cached on device
+        # once — uploads are cheap, downloads are not)
         vb = jnp.asarray(valid_data.bins)
+        self._valid_bins_dev.append(vb)
         for t_idx, rec in enumerate(self.records):
             cls = t_idx % self.num_tree_per_iteration
             leaf = replay_partition(rec, vb, self._meta)
@@ -176,18 +200,10 @@ class GBDT:
                 or self.objective is None
                 or self.train_data.metadata.init_score is not None):
             return 0.0
-        name = self.objective.name
-        if name in ("regression", "regression_l1", "quantile", "huber",
-                    "fair", "mape", "binary", "cross_entropy"):
-            init = self.objective.boost_from_score(class_id)
-            if init != 0.0:
-                self._scores = self._scores.at[class_id].add(init)
-                for i in range(len(self._valid_scores)):
-                    self._valid_scores[i] = \
-                        self._valid_scores[i].at[class_id].add(init)
-                log.info("Start training from score %g", init)
-            return init
-        if name in ("poisson", "gamma", "tweedie"):
+        if self.objective.name in (
+                "regression", "regression_l1", "quantile", "huber",
+                "fair", "mape", "binary", "cross_entropy",
+                "poisson", "gamma", "tweedie"):
             init = self.objective.boost_from_score(class_id)
             if init != 0.0:
                 self._scores = self._scores.at[class_id].add(init)
@@ -207,144 +223,198 @@ class GBDT:
         already carries shrinkage and (for the first iteration) the
         boost-from-average bias, exactly like the reference's
         ``Shrinkage`` + ``AddBias`` on the saved tree (gbdt.cpp:371-377).
+
+        Entirely device-resident: no device→host transfer happens here.
+        The "no more splits" stop is detected by a periodic host check
+        (every ``tpu_stop_check_interval`` iterations).
         """
-        init_scores = [0.0] * self.num_tree_per_iteration
+        K = self.num_tree_per_iteration
+        init_scores = [0.0] * K
         if grad is None or hess is None:
             if self.objective is None:
                 log.fatal("No objective; pass custom grad/hess")
-            for k in range(self.num_tree_per_iteration):
+            for k in range(K):
                 init_scores[k] = self.boost_from_average(k)
-            g_all, h_all = self.objective.get_gradients(self._scores
-                if self.num_tree_per_iteration > 1 else self._scores[0])
-            if self.num_tree_per_iteration == 1:
+            g_all, h_all = self.objective.get_gradients(
+                self._scores if K > 1 else self._scores[0])
+            if K == 1:
                 g_all, h_all = g_all[None, :], h_all[None, :]
         else:
-            g_all = jnp.asarray(grad, jnp.float32).reshape(
-                self.num_tree_per_iteration, self._n)
-            h_all = jnp.asarray(hess, jnp.float32).reshape(
-                self.num_tree_per_iteration, self._n)
+            g_all = jnp.asarray(grad, jnp.float32).reshape(K, self._n)
+            h_all = jnp.asarray(hess, jnp.float32).reshape(K, self._n)
 
         mask_np = self._bagging_mask(self.iter_)
         mask = (jnp.ones(self._n, jnp.float32) if mask_np is None
                 else jnp.asarray(mask_np))
         fmask = jnp.asarray(self._feature_mask())
 
-        should_continue = False
-        for k in range(self.num_tree_per_iteration):
+        first_iteration = not self.models
+        for k in range(K):
             rec, leaf_ids = self._grower(self._bins_dev, g_all[k], h_all[k],
                                          mask, fmask)
-            nl = int(rec.num_leaves)
-            if nl > 1:
-                should_continue = True
-                rec = self._renew_tree_output(rec, k, leaf_ids)
-                # fold shrinkage into outputs (Tree::Shrinkage)
-                shrunk = rec.leaf_output * self.shrinkage_rate
-                rec = rec._replace(
-                    leaf_output=shrunk,
-                    internal_value=rec.internal_value * self.shrinkage_rate)
-                self._scores = self._scores.at[k].set(add_leaf_outputs(
-                    self._scores[k], leaf_ids, rec.leaf_output, 1.0))
-                # out-of-bag rows included: the partition covers ALL rows.
-                for vi, vset in enumerate(self.valid_sets):
-                    vb = jnp.asarray(vset.bins)
-                    vleaf = replay_partition(rec, vb, self._meta)
-                    self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
-                        add_leaf_outputs(self._valid_scores[vi][k], vleaf,
-                                         rec.leaf_output, 1.0))
-                shrinkage_for_file = self.shrinkage_rate
-                if abs(init_scores[k]) > 1e-15:
-                    # AddBias folds the init into the saved model (tree.h:151)
-                    rec = rec._replace(
-                        leaf_output=rec.leaf_output + init_scores[k],
-                        internal_value=rec.internal_value + init_scores[k])
-                    shrinkage_for_file = 1.0
-                tree = tree_from_record(
-                    rec, self.train_data.mappers,
-                    self.train_data.used_feature_map,
-                    1.0, self._grower_cfg.num_leaves)
-                tree.shrinkage = shrinkage_for_file
-                self.models.append(tree)
-                self.records.append(rec)
-            else:
-                # constant tree on the first iteration (gbdt.cpp:378-396)
-                if len(self.models) < self.num_tree_per_iteration:
-                    output = init_scores[k]
-                    if output == 0.0 and self.objective is not None:
-                        output = 0.0
-                    rec = rec._replace(
-                        leaf_output=jnp.zeros_like(rec.leaf_output)
-                        .at[0].set(output))
-                    if output != 0.0:
-                        self._scores = self._scores.at[k].add(output)
-                        for vi in range(len(self._valid_scores)):
-                            self._valid_scores[vi] = \
-                                self._valid_scores[vi].at[k].add(output)
-                    tree = tree_from_record(
-                        rec, self.train_data.mappers,
-                        self.train_data.used_feature_map, 1.0,
-                        self._grower_cfg.num_leaves)
-                    self.models.append(tree)
-                    self.records.append(rec)
-                else:
-                    self.models.append(Tree(2))
-                    self.records.append(rec._replace(
-                        leaf_output=jnp.zeros_like(rec.leaf_output)))
-
-        if not should_continue:
-            log.warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements")
-            if len(self.models) > self.num_tree_per_iteration:
-                for _ in range(self.num_tree_per_iteration):
-                    self.models.pop()
-                    self.records.pop()
-            return True
-        self.iter_ += 1
-        return False
-
-    def _renew_tree_output(self, rec, class_id, leaf_ids):
-        """Objective-driven leaf refit (serial_tree_learner.cpp:780-818):
-        L1/quantile/MAPE replace leaf outputs with residual percentiles."""
-        obj = self.objective
-        if obj is None or not obj.is_renew_tree_output():
-            return rec
-        alpha = obj.renew_tree_output_percentile()
-        leaf_np = np.asarray(leaf_ids)
-        score_np = np.asarray(self._scores[class_id])
-        label = obj.trans_label if hasattr(obj, "trans_label") else obj.label
-        residual = label - score_np
-        w = getattr(obj, "label_weight", None)
-        if w is None:
-            w = obj.weights
-        outputs = np.asarray(rec.leaf_output).copy()
-        nl = int(rec.num_leaves)
-        from ..objectives.objective import _weighted_percentile
-        for leaf in range(nl):
-            in_leaf = leaf_np == leaf
-            if not in_leaf.any():
-                continue
-            res = residual[in_leaf]
-            ww = None if w is None else np.asarray(w)[in_leaf]
-            outputs[leaf] = _weighted_percentile(res, ww, alpha)
-        return rec._replace(leaf_output=jnp.asarray(outputs))
-
-    def rollback_one_iter(self) -> None:
-        """RollbackOneIter (gbdt.cpp:414-430)."""
-        if self.iter_ <= 0:
-            return
-        for k in range(self.num_tree_per_iteration - 1, -1, -1):
-            rec = self.records.pop()
-            self.models.pop()
-            # subtract scores
-            leaf = replay_partition(rec, self._bins_dev, self._meta)
+            rec = self._renew_tree_output(rec, k, leaf_ids, mask)
+            # fold shrinkage into outputs (Tree::Shrinkage, gbdt.cpp:371)
+            rec = rec._replace(
+                leaf_output=rec.leaf_output * self.shrinkage_rate,
+                internal_value=rec.internal_value * self.shrinkage_rate)
+            # out-of-bag rows included: the partition covers ALL rows.
             self._scores = self._scores.at[k].set(add_leaf_outputs(
-                self._scores[k], leaf, rec.leaf_output, -1.0))
-            for vi, vset in enumerate(self.valid_sets):
-                vb = jnp.asarray(vset.bins)
+                self._scores[k], leaf_ids, rec.leaf_output, 1.0))
+            for vi in range(len(self.valid_sets)):
+                vb = self._valid_bins_dev[vi]
                 vleaf = replay_partition(rec, vb, self._meta)
                 self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
                     add_leaf_outputs(self._valid_scores[vi][k], vleaf,
-                                     rec.leaf_output, -1.0))
-        self.iter_ -= 1
+                                     rec.leaf_output, 1.0))
+            shrinkage_for_file = self.shrinkage_rate
+            if first_iteration and abs(init_scores[k]) > 1e-15:
+                # AddBias folds the init into the saved model (tree.h:151).
+                # For a splitless tree this also yields the reference's
+                # constant tree (leaf0 = init, gbdt.cpp:378-396); adding
+                # the bias to unused leaf slots is harmless (leaf_ids
+                # never reference them).
+                rec = rec._replace(
+                    leaf_output=rec.leaf_output + init_scores[k],
+                    internal_value=rec.internal_value + init_scores[k])
+                shrinkage_for_file = 1.0
+            self.records.append(rec)
+            self.models.append(None)
+            self._tree_shrinkage.append(shrinkage_for_file)
+
+        self.iter_ += 1
+        if self.iter_ % self._stop_check_interval == 0:
+            return self._check_stop()
+        return False
+
+    def _num_leaves_host(self, records) -> np.ndarray:
+        """Download num_leaves for a list of records in ONE transfer."""
+        if not records:
+            return np.zeros(0, np.int32)
+        stacked = jnp.stack([r.num_leaves for r in records])
+        return np.asarray(stacked)
+
+    def _drop_last_iterations(self, n_groups: int) -> None:
+        """Remove the last ``n_groups`` boosting iterations AND subtract
+        their score contributions (shared by stop-trim and rollback)."""
+        K = self.num_tree_per_iteration
+        for _ in range(n_groups):
+            for k in range(K - 1, -1, -1):
+                rec = self.records.pop()
+                self.models.pop()
+                self._tree_shrinkage.pop()
+                leaf = replay_partition(rec, self._bins_dev, self._meta)
+                self._scores = self._scores.at[k].set(add_leaf_outputs(
+                    self._scores[k], leaf, rec.leaf_output, -1.0))
+                for vi in range(len(self.valid_sets)):
+                    vleaf = replay_partition(rec, self._valid_bins_dev[vi],
+                                             self._meta)
+                    self._valid_scores[vi] = \
+                        self._valid_scores[vi].at[k].set(add_leaf_outputs(
+                            self._valid_scores[vi][k], vleaf,
+                            rec.leaf_output, -1.0))
+            self.iter_ -= 1
+        self._clean_groups = min(self._clean_groups, self.iter_)
+
+    def _first_splitless_group(self) -> Optional[int]:
+        """Index of the first iteration in which NO class tree could
+        split — where the reference stops (gbdt.cpp:393-409). Scans only
+        groups not yet verified productive; one device download of the
+        scanned tail. None if every iteration was productive."""
+        K = self.num_tree_per_iteration
+        num_groups = len(self.records) // K
+        if num_groups <= self._clean_groups:
+            return None
+        tail = self.records[self._clean_groups * K:num_groups * K]
+        nl = self._num_leaves_host(tail)
+        groups = nl.reshape(-1, K)
+        for i in range(len(groups)):
+            if (groups[i] <= 1).all():
+                return self._clean_groups + i
+            self._clean_groups += 1
+        return None
+
+    def _trim_at_splitless(self, gi: int) -> None:
+        """Drop the splitless iteration ``gi`` and everything after it.
+        A splitless iteration 0 is kept as the reference's constant first
+        tree (gbdt.cpp:378-396) but still stops training."""
+        keep = max(gi, 1)
+        self._drop_last_iterations(self.iter_ - keep)
+        self._stopped = True
+        log.warning("Stopped training because there are no more leaves "
+                    "that meet the split requirements")
+
+    def _check_stop(self) -> bool:
+        """Periodic host check for the reference's early stop; removes
+        the splitless iteration and everything trained after it (score
+        contributions subtracted, so state stays consistent)."""
+        if self._stopped:
+            return True
+        gi = self._first_splitless_group()
+        if gi is None:
+            return False
+        self._trim_at_splitless(gi)
+        return True
+
+    def finish_training(self) -> None:
+        """Final trim; call once after the boosting loop. Mirrors
+        _check_stop for splitless iterations that landed after the last
+        periodic check."""
+        if self._stopped:
+            return
+        gi = self._first_splitless_group()
+        if gi is not None:
+            self._trim_at_splitless(gi)
+
+    # -- lazy host-tree materialization --------------------------------------
+
+    def _ensure_host_trees(self) -> None:
+        """Build host Tree mirrors for all device records that don't have
+        one yet — a single packed stacked download for all of them."""
+        missing = [i for i, m in enumerate(self.models) if m is None]
+        if not missing:
+            return
+        packed = jnp.stack([pack_record(self.records[i]) for i in missing])
+        packed_np = np.asarray(packed)
+        L = self._grower_cfg.num_leaves
+        for row, i in enumerate(missing):
+            rec_np = unpack_record(packed_np[row], L)
+            tree = tree_from_record(
+                rec_np, self.train_data.mappers,
+                self.train_data.used_feature_map, 1.0, L)
+            tree.shrinkage = self._tree_shrinkage[i]
+            self.models[i] = tree
+
+    def _renew_tree_output(self, rec, class_id, leaf_ids, sample_mask):
+        """Objective-driven leaf refit (serial_tree_learner.cpp:780-818):
+        L1/quantile/MAPE replace leaf outputs with residual percentiles.
+        Runs on device (renew_leaf_outputs) — no host transfer."""
+        obj = self.objective
+        if obj is None or not obj.is_renew_tree_output():
+            return rec
+        from ..ops.renew import renew_leaf_outputs
+        alpha = obj.renew_tree_output_percentile()
+        label = (obj.trans_label if hasattr(obj, "trans_label")
+                 else obj.label)
+        residual = jnp.asarray(label, jnp.float32) - self._scores[class_id]
+        w = getattr(obj, "label_weight", None)
+        if w is None:
+            w = obj.weights
+        w_dev = (None if w is None else jnp.asarray(w, jnp.float32))
+        new_out = renew_leaf_outputs(
+            leaf_ids, residual, w_dev, self._grower_cfg.num_leaves,
+            float(alpha), rec.leaf_output, sample_mask)
+        # splitless trees must stay all-zero (the reference never renews
+        # a tree it is about to discard, gbdt.cpp:393-409)
+        new_out = jnp.where(rec.num_leaves > 1, new_out, rec.leaf_output)
+        return rec._replace(leaf_output=new_out)
+
+    def rollback_one_iter(self) -> None:
+        """RollbackOneIter (gbdt.cpp:414-430). Training may resume
+        afterwards, so the stop latch is cleared."""
+        if self.iter_ <= 0:
+            return
+        self._drop_last_iterations(1)
+        self._stopped = False
 
     # -- evaluation (gbdt.cpp:432-534) --------------------------------------
 
@@ -369,26 +439,41 @@ class GBDT:
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
                     start_iteration: int = 0) -> np.ndarray:
         """Raw scores [N] or [N, K]. Device path: bin with train mappers,
-        replay trees (gbdt_prediction.cpp:9-30)."""
+        replay trees on device, ONE download (gbdt_prediction.cpp:9-30)."""
         X = np.asarray(X, np.float64)
         n = X.shape[0]
         k = self.num_tree_per_iteration
-        ntree = len(self.models)
+        # live predictions see the same trees a checkpoint would contain
+        ntree = self._effective_num_models()
         if num_iteration >= 0:
             ntree = min(ntree, (start_iteration + num_iteration) * k)
-        bins = self._bin_input(X)
-        bins_dev = jnp.asarray(bins)
-        out = np.zeros((k, n), np.float64)
-        for t_idx in range(start_iteration * k, ntree):
-            rec = self.records[t_idx] if t_idx < len(self.records) else None
-            cls = t_idx % k
-            if rec is not None:
-                leaf = replay_partition(rec, bins_dev, self._meta)
-                out[cls] += np.asarray(rec.leaf_output)[np.asarray(leaf)]
-            else:
-                out[cls] += self.models[t_idx].predict(X)
-        if self.average_output and self.iter_ > 0:
-            out /= self.iter_
+        first = start_iteration * k
+        if self.train_data is not None and len(self.records) >= ntree:
+            bins_dev = jnp.asarray(self._bin_input(X))
+            acc = jnp.zeros((k, n), jnp.float32)
+            # pairwise-sum trees in chunks: bounds f32 accumulation error
+            # to ~log(T) depth instead of T (reference predicts in double)
+            chunk = 32
+            for cls in range(k):
+                idxs = [t for t in range(first, ntree) if t % k == cls]
+                for c0 in range(0, len(idxs), chunk):
+                    part = []
+                    for t_idx in idxs[c0:c0 + chunk]:
+                        rec = self.records[t_idx]
+                        leaf = replay_partition(rec, bins_dev, self._meta)
+                        part.append(rec.leaf_output[leaf])
+                    acc = acc.at[cls].add(jnp.sum(jnp.stack(part), axis=0))
+            out = np.asarray(acc).astype(np.float64)
+        else:
+            self._ensure_host_trees()
+            out = np.zeros((k, n), np.float64)
+            for t_idx in range(first, ntree):
+                out[t_idx % k] += self.models[t_idx].predict(X)
+        if self.average_output:
+            # reference divides by the iteration count actually predicted
+            # (gbdt_prediction.cpp:51-65)
+            used_iters = max((ntree - first) // k, 1)
+            out /= used_iters
         return out[0] if k == 1 else out.T
 
     def _bin_input(self, X: np.ndarray) -> np.ndarray:
@@ -403,13 +488,18 @@ class GBDT:
     def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         raw = self.predict_raw(X, num_iteration)
         if self.objective is not None:
-            return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+            # convert_output operates class-major [K, N] like the
+            # reference's ConvertOutput; predict_raw returns [N, K]
+            r = raw.T if raw.ndim == 2 else raw
+            out = np.asarray(self.objective.convert_output(jnp.asarray(r)))
+            return out.T if raw.ndim == 2 else out
         return raw
 
     def predict_leaf_index(self, X: np.ndarray,
                            num_iteration: int = -1) -> np.ndarray:
+        self._ensure_host_trees()
         X = np.asarray(X, np.float64)
-        ntree = len(self.models)
+        ntree = self._effective_num_models()
         if num_iteration >= 0:
             ntree = min(ntree, num_iteration * self.num_tree_per_iteration)
         out = np.zeros((X.shape[0], ntree), np.int32)
@@ -421,7 +511,8 @@ class GBDT:
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = 0) -> np.ndarray:
-        n_models = len(self.models)
+        self._ensure_host_trees()
+        n_models = self._effective_num_models()
         if iteration > 0:
             n_models = min(n_models, iteration * self.num_tree_per_iteration)
         imp = np.zeros(self.max_feature_idx + 1, np.float64)
@@ -435,8 +526,20 @@ class GBDT:
 
     # -- model text serialization (gbdt_model_text.cpp:240-338) --------------
 
+    def _effective_num_models(self) -> int:
+        """Number of trees a reference-equivalent model would contain:
+        everything before the first splitless iteration. Non-mutating, so
+        mid-training checkpoints don't alter the booster."""
+        n = len(self.models)
+        if self.records and not self._stopped:
+            gi = self._first_splitless_group()
+            if gi is not None:
+                n = min(n, max(gi, 1) * self.num_tree_per_iteration)
+        return n
+
     def model_to_string(self, start_iteration: int = 0,
                         num_iteration: int = -1) -> str:
+        self._ensure_host_trees()
         lines = ["tree"]
         lines.append(f"version={K_MODEL_VERSION}")
         lines.append(f"num_class={self.num_class}")
@@ -450,9 +553,10 @@ class GBDT:
         lines.append("feature_names=" + " ".join(self.feature_names))
         lines.append("feature_infos=" + " ".join(self.feature_infos))
 
-        total_iter = len(self.models) // max(self.num_tree_per_iteration, 1)
+        eff = self._effective_num_models()
+        total_iter = eff // max(self.num_tree_per_iteration, 1)
         start_iteration = max(0, min(start_iteration, total_iter))
-        num_used = len(self.models)
+        num_used = eff
         if num_iteration > 0:
             num_used = min((start_iteration + num_iteration)
                            * self.num_tree_per_iteration, num_used)
@@ -467,7 +571,8 @@ class GBDT:
         body = "\n".join(lines) + "\n" + "".join(tree_strs)
         body += "end of trees\n"
 
-        imp = self.feature_importance("split")
+        imp = self.feature_importance(
+            "split", iteration=num_used // max(self.num_tree_per_iteration, 1))
         pairs = [(int(imp[i]), self.feature_names[i])
                  for i in range(len(imp)) if imp[i] > 0]
         pairs.sort(key=lambda p: -p[0])
@@ -541,7 +646,8 @@ class GBDT:
     def dump_model(self, start_iteration: int = 0,
                    num_iteration: int = -1) -> dict:
         """DumpModel JSON (gbdt_model_text.cpp:15-54)."""
-        num_used = len(self.models)
+        self._ensure_host_trees()
+        num_used = self._effective_num_models()
         if num_iteration > 0:
             num_used = min((start_iteration + num_iteration)
                            * self.num_tree_per_iteration, num_used)

@@ -303,7 +303,8 @@ def tree_from_record(rec, mappers, real_features, shrinkage: float,
     ``mappers``: BinMapper per inner feature; ``real_features``: inner
     feature index -> original column index mapping.
     """
-    rec_np = {k: np.asarray(v) for k, v in rec._asdict().items()}
+    rec_np = (rec if isinstance(rec, dict)
+              else {k: np.asarray(v) for k, v in rec._asdict().items()})
     nl = int(rec_np["num_leaves"])
     t = Tree(max_leaves)
     for i in range(nl - 1):

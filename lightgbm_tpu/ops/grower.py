@@ -59,6 +59,50 @@ class TreeRecord(NamedTuple):
     internal_count: jax.Array      # [L-1]
 
 
+@jax.jit
+def pack_record(rec: TreeRecord) -> jax.Array:
+    """Flatten a TreeRecord into ONE [P] float32 array.
+
+    Device→host transfers in this environment have high fixed latency per
+    buffer, so the host materializes trees from a single stacked download
+    (``jnp.stack([pack_record(r) for r in recs])``) instead of 12 small
+    transfers per tree. float32 holds counts/bins exactly below 2^24.
+    """
+    f32 = jnp.float32
+    return jnp.concatenate([
+        rec.num_leaves[None].astype(f32) if rec.num_leaves.ndim == 0
+        else rec.num_leaves.astype(f32),
+        rec.split_leaf.astype(f32),
+        rec.split_feature.astype(f32),
+        rec.split_bin.astype(f32),
+        rec.split_gain.astype(f32),
+        rec.split_default_left.astype(f32),
+        rec.leaf_output.astype(f32),
+        rec.leaf_count.astype(f32),
+        rec.leaf_sum_g.astype(f32),
+        rec.leaf_sum_h.astype(f32),
+        rec.internal_value.astype(f32),
+        rec.internal_count.astype(f32),
+    ])
+
+
+def unpack_record(arr, num_leaves_cap: int) -> dict:
+    """Inverse of pack_record on a host numpy [P] row -> dict of arrays."""
+    L = num_leaves_cap
+    s = L - 1
+    parts = {}
+    off = 0
+    parts["num_leaves"] = int(round(float(arr[0]))); off = 1
+    for name in ("split_leaf", "split_feature", "split_bin", "split_gain",
+                 "split_default_left"):
+        parts[name] = arr[off:off + s]; off += s
+    for name in ("leaf_output", "leaf_count", "leaf_sum_g", "leaf_sum_h"):
+        parts[name] = arr[off:off + L]; off += L
+    for name in ("internal_value", "internal_count"):
+        parts[name] = arr[off:off + s]; off += s
+    return parts
+
+
 class _State(NamedTuple):
     leaf_ids: jax.Array
     hist: jax.Array            # [L, F, B, 3]

@@ -18,6 +18,7 @@ from .partition import apply_split
 from .split import FeatureMeta
 
 
+@jax.jit
 def replay_partition(rec, bins, meta: FeatureMeta):
     """Assign each row of ``bins`` [N, F] to a leaf of the recorded tree by
     replaying its splits (Tree numbering: split i's right child = leaf i+1).

@@ -1,0 +1,96 @@
+"""Test configuration: force an 8-device virtual CPU platform so that
+multi-chip sharding (data/feature/voting parallel learners) is exercised
+in-process — fixing the reference's distributed-test gap (SURVEY.md §4.4:
+the reference has no multi-node test at all).
+
+Must run before jax is imported anywhere.
+"""
+import os
+
+# Force (not setdefault: the axon environment presets JAX_PLATFORMS=axon,
+# and running unit tests over the TPU tunnel makes every host transfer a
+# ~90ms RPC).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Persistent compile cache: distinct grower shapes compile once per
+# machine, not once per pytest run.
+jax.config.update("jax_compilation_cache_dir", "/tmp/lgbm_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# Small standard shapes keep XLA compile time per distinct grower shape
+# bounded; every test that can share a shape should use these.
+TEST_PARAMS = {"num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 20}
+
+
+def fit_gbdt(X, y, params, num_round=30, weight=None, group=None,
+             valid=None):
+    """Train a GBDT the low-level way (shared by many tests)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import TpuDataset, Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.metrics import create_metrics
+
+    full = dict(TEST_PARAMS)
+    full.update(params)
+    cfg = Config().set(full)
+    md = Metadata(label=y, weight=weight, group=group)
+    ds = TpuDataset(cfg).construct_from_matrix(
+        X, md, categorical=cfg.categorical_feature)
+    obj = create_objective(cfg.objective, cfg)
+    if obj is not None:
+        obj.init(ds.metadata, ds.num_data)
+    metrics = create_metrics(cfg.metric or [cfg.objective], cfg,
+                             ds.metadata, ds.num_data)
+    g = GBDT()
+    g.init(cfg, ds, obj, metrics)
+    if valid is not None:
+        Xv, yv = valid
+        vd = ds.create_valid(Xv, Metadata(label=yv))
+        vm = create_metrics(cfg.metric or [cfg.objective], cfg,
+                            vd.metadata, vd.num_data)
+        g.add_valid_data(vd, vm)
+    for _ in range(num_round):
+        if g.train_one_iter():
+            break
+    g.finish_training()
+    return g
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+def make_binary(n=1280, f=10, seed=0):
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(n, f))
+    logit = X[:, 0] + 0.5 * X[:, 1] * X[:, 2] - 0.25 * X[:, 3]
+    y = (logit + 0.1 * r.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def make_regression(n=1280, f=10, seed=1):
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(n, f))
+    y = (2.0 * X[:, 0] + X[:, 1] ** 2 + 0.5 * X[:, 2] * X[:, 3]
+         + 0.1 * r.normal(size=n)).astype(np.float32)
+    return X, y
+
+
+def make_multiclass(n=1280, f=10, k=4, seed=2):
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(n, f))
+    centers = r.normal(size=(k, f)) * 2.0
+    d = ((X[:, None, :] - centers[None]) ** 2).sum(-1)
+    y = np.argmin(d + 0.5 * r.normal(size=(n, k)), axis=1).astype(np.float32)
+    return X, y

@@ -1,0 +1,131 @@
+"""BinMapper semantics tests (reference: src/io/bin.cpp:74-208
+GreedyFindBin / FindBinWithZeroAsOneBin, bin.h:452-488 ValueToBin)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.binning import BinMapper, BinType, MissingType
+from lightgbm_tpu.io.dataset import TpuDataset, Metadata
+from lightgbm_tpu.config import Config
+
+
+def _fit_mapper(values, max_bin=255, **kw):
+    values = np.asarray(values, np.float64)
+    nz = values[(np.abs(values) > 1e-35) | np.isnan(values)]
+    m = BinMapper()
+    m.find_bin(nz, len(values), max_bin, kw.pop("min_data_in_bin", 3),
+               kw.pop("filter_cnt", 0), kw.pop("bin_type", BinType.NUMERICAL),
+               kw.pop("use_missing", True), kw.pop("zero_as_missing", False))
+    return m
+
+
+class TestNumerical:
+    def test_monotone_bounds(self):
+        r = np.random.default_rng(0)
+        v = r.normal(size=5000)
+        m = _fit_mapper(v, max_bin=63)
+        assert 2 <= m.num_bin <= 63
+        # value_to_bin must be monotone in value
+        xs = np.sort(r.normal(size=1000))
+        bins = m.value_to_bin(xs)
+        assert np.all(np.diff(bins) >= 0)
+
+    def test_roundtrip_ordering(self):
+        r = np.random.default_rng(1)
+        v = r.uniform(-10, 10, size=2000)
+        m = _fit_mapper(v, max_bin=31)
+        for b in range(1, m.num_bin - 1):
+            lo = m.bin_to_value(b - 1)
+            hi = m.bin_to_value(b)
+            assert lo <= hi
+
+    def test_few_distinct_values_exact_bins(self):
+        v = np.array([1.0, 2.0, 3.0] * 100)
+        m = _fit_mapper(v, max_bin=255)
+        b1 = m.value_to_bin(np.array([1.0]))[0]
+        b2 = m.value_to_bin(np.array([2.0]))[0]
+        b3 = m.value_to_bin(np.array([3.0]))[0]
+        assert len({int(b1), int(b2), int(b3)}) == 3
+
+    def test_nan_goes_to_last_bin(self):
+        r = np.random.default_rng(2)
+        v = r.normal(size=1000)
+        v[::10] = np.nan
+        m = _fit_mapper(v)
+        assert m.missing_type == MissingType.NAN
+        nb = m.value_to_bin(np.array([np.nan]))[0]
+        assert nb == m.num_bin - 1
+
+    def test_zero_as_missing(self):
+        r = np.random.default_rng(3)
+        v = r.normal(size=1000)
+        v[::5] = 0.0
+        m = _fit_mapper(v, zero_as_missing=True)
+        assert m.missing_type == MissingType.ZERO
+
+    def test_trivial_constant_feature(self):
+        v = np.full(100, 3.14)
+        m = _fit_mapper(v)
+        assert m.is_trivial or m.num_bin <= 2
+
+
+class TestCategorical:
+    def test_categories_to_distinct_bins(self):
+        r = np.random.default_rng(4)
+        v = r.integers(0, 10, size=2000).astype(np.float64)
+        m = _fit_mapper(v, bin_type=BinType.CATEGORICAL)
+        bins = m.value_to_bin(np.arange(10, dtype=np.float64))
+        # the most frequent categories must all get distinct bins
+        assert len(set(int(b) for b in bins)) >= 9
+
+    def test_unseen_category_to_last_bin(self):
+        # reference ValueToBin (bin.h:482-487): unseen/negative -> num_bin-1
+        v = np.array([1.0, 2.0, 3.0] * 50)
+        m = _fit_mapper(v, bin_type=BinType.CATEGORICAL)
+        assert int(m.value_to_bin(np.array([99.0]))[0]) == m.num_bin - 1
+        assert int(m.value_to_bin(np.array([-5.0]))[0]) == m.num_bin - 1
+
+
+class TestDataset:
+    def test_trivial_features_excluded(self):
+        r = np.random.default_rng(5)
+        X = r.normal(size=(500, 5))
+        X[:, 3] = 7.0  # constant
+        cfg = Config().set({"objective": "regression"})
+        ds = TpuDataset(cfg).construct_from_matrix(
+            X, Metadata(label=r.normal(size=500)))
+        assert ds.num_features == 4
+        assert 3 not in set(ds.used_feature_map.tolist())
+        infos = ds.feature_infos()
+        assert infos[3] == "none"
+
+    def test_valid_reuses_mappers(self):
+        r = np.random.default_rng(6)
+        X = r.normal(size=(500, 4))
+        cfg = Config().set({"objective": "regression"})
+        ds = TpuDataset(cfg).construct_from_matrix(
+            X, Metadata(label=r.normal(size=500)))
+        Xv = r.normal(size=(100, 4))
+        vd = ds.create_valid(Xv, Metadata(label=r.normal(size=100)))
+        assert vd.mappers is ds.mappers
+        assert vd.num_data == 100
+
+    def test_binary_cache_roundtrip(self, tmp_path):
+        r = np.random.default_rng(7)
+        X = r.normal(size=(300, 4))
+        y = r.normal(size=300)
+        cfg = Config().set({"objective": "regression"})
+        ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+        fn = str(tmp_path / "data.bin")
+        ds.save_binary(fn)
+        assert TpuDataset.is_binary_file(fn)
+        ds2 = TpuDataset.load_binary(fn, cfg)
+        np.testing.assert_array_equal(ds.bins, ds2.bins)
+        np.testing.assert_array_equal(ds.metadata.label, ds2.metadata.label)
+
+    def test_max_bin_respected(self):
+        r = np.random.default_rng(8)
+        X = r.normal(size=(2000, 3))
+        cfg = Config().set({"objective": "regression", "max_bin": 15})
+        ds = TpuDataset(cfg).construct_from_matrix(
+            X, Metadata(label=r.normal(size=2000)))
+        assert all(m.num_bin <= 15 for m in ds.mappers)
