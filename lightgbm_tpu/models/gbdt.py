@@ -97,9 +97,17 @@ class GBDT:
 
         n = train_data.num_data
         self._n = n
-        self._bins_dev = jnp.asarray(train_data.bins)
         self._meta = train_data.feature_meta()
         self._setup_grower()
+        bins = train_data.bins
+        if self._pad_rows:
+            bins = np.pad(bins, ((0, self._pad_rows), (0, 0)))
+        if self._pad_features:
+            bins = np.pad(bins, ((0, 0), (0, self._pad_features)))
+        self._bins_dev = jnp.asarray(bins)
+        self._full_mask_dev = jnp.asarray(np.concatenate(
+            [np.ones(self._n, np.float32),
+             np.zeros(self._pad_rows, np.float32)]))
         self._init_scores()
         self._bagging_rng = np.random.default_rng(config.bagging_seed)
         self._feature_rng = np.random.default_rng(config.feature_fraction_seed)
@@ -121,15 +129,60 @@ class GBDT:
             min_data_in_leaf=float(cfg.min_data_in_leaf),
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
             min_gain_to_split=cfg.min_gain_to_split)
+
+        # distributed learner selection (tree_learner.cpp:9-33 analog):
+        # tree_learner = serial|feature|data|voting over the device mesh
+        from ..parallel.learners import make_grower_for_mode, make_mesh
+        mode = cfg.tree_learner
+        want = cfg.num_machines if cfg.num_machines > 1 else None
+        mesh = None
+        if mode != "serial":
+            mesh = make_mesh(want)
+            if mesh.devices.size == 1:
+                log.warning("tree_learner=%s requested but only one device"
+                            " is available; falling back to serial", mode)
+                mesh, mode = None, "serial"
+        self._mesh = mesh
+        self._learner_mode = mode
+        D = mesh.devices.size if mesh is not None else 1
+
+        f = max(self.train_data.num_features, 1)
+        self._pad_rows = 0
+        self._pad_features = 0
+        meta = self._meta
+        if mode in ("data", "voting"):
+            self._pad_rows = (-self._n) % D
+        if mode == "feature":
+            self._pad_features = (-f) % D
+            if self._pad_features:
+                pad = self._pad_features
+                meta = type(meta)(
+                    num_bin=np.concatenate(
+                        [meta.num_bin, np.ones(pad, np.int32)]),
+                    missing_type=np.concatenate(
+                        [meta.missing_type, np.zeros(pad, np.int32)]),
+                    default_bin=np.concatenate(
+                        [meta.default_bin, np.zeros(pad, np.int32)]),
+                    monotone=np.concatenate(
+                        [meta.monotone, np.zeros(pad, np.int32)]),
+                    penalty=np.concatenate(
+                        [meta.penalty, np.ones(pad, np.float32)]))
+                self._meta = meta
+        self._n_pad = self._n + self._pad_rows
+        self._f_pad = f + self._pad_features
+
         # depth cap: reference grows leaf-wise; max_depth bounds node depth
+        local_rows = self._n_pad // D if mode in ("data", "voting") \
+            else self._n_pad
         gcfg = GrowerConfig(
             num_leaves=max(cfg.num_leaves, 2),
             num_bins=self.train_data.max_bin_global,
             max_depth=cfg.max_depth,
-            chunk=min(cfg.tpu_hist_chunk, _round_up(self._n, 128)),
+            chunk=min(cfg.tpu_hist_chunk, _round_up(local_rows, 128)),
             hp=hp)
         self._grower_cfg = gcfg
-        self._grower = make_tree_grower(gcfg, self._meta)
+        self._grower = make_grower_for_mode(
+            mode, gcfg, meta, mesh, self._f_pad, cfg.top_k)
 
     def _init_scores(self):
         n, k = self._n, self.num_tree_per_iteration
@@ -244,15 +297,32 @@ class GBDT:
             h_all = jnp.asarray(hess, jnp.float32).reshape(K, self._n)
 
         mask_np = self._bagging_mask(self.iter_)
-        mask = (jnp.ones(self._n, jnp.float32) if mask_np is None
-                else jnp.asarray(mask_np))
-        fmask = jnp.asarray(self._feature_mask())
+        if mask_np is None:
+            mask = self._full_mask_dev  # precomputed padded all-ones mask
+        else:
+            if self._pad_rows:
+                mask_np = np.concatenate(
+                    [mask_np, np.zeros(self._pad_rows, np.float32)])
+            mask = jnp.asarray(mask_np)
+        fmask_np = self._feature_mask()
+        if self._pad_features:
+            fmask_np = np.concatenate(
+                [fmask_np, np.zeros(self._pad_features, bool)])
+        fmask = jnp.asarray(fmask_np)
 
         first_iteration = not self.models
         for k in range(K):
-            rec, leaf_ids = self._grower(self._bins_dev, g_all[k], h_all[k],
+            g_k, h_k = g_all[k], h_all[k]
+            if self._pad_rows:
+                g_k = jnp.concatenate(
+                    [g_k, jnp.zeros(self._pad_rows, jnp.float32)])
+                h_k = jnp.concatenate(
+                    [h_k, jnp.zeros(self._pad_rows, jnp.float32)])
+            rec, leaf_ids = self._grower(self._bins_dev, g_k, h_k,
                                          mask, fmask)
-            rec = self._renew_tree_output(rec, k, leaf_ids, mask)
+            leaf_ids = leaf_ids[:self._n]
+            rec = self._renew_tree_output(rec, k, leaf_ids,
+                                          mask[:self._n])
             # fold shrinkage into outputs (Tree::Shrinkage, gbdt.cpp:371)
             rec = rec._replace(
                 leaf_output=rec.leaf_output * self.shrinkage_rate,
@@ -302,7 +372,8 @@ class GBDT:
                 rec = self.records.pop()
                 self.models.pop()
                 self._tree_shrinkage.pop()
-                leaf = replay_partition(rec, self._bins_dev, self._meta)
+                leaf = replay_partition(rec, self._bins_dev,
+                                        self._meta)[:self._n]
                 self._scores = self._scores.at[k].set(add_leaf_outputs(
                     self._scores[k], leaf, rec.leaf_output, -1.0))
                 for vi in range(len(self.valid_sets)):

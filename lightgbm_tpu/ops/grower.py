@@ -130,12 +130,23 @@ class _State(NamedTuple):
 
 
 def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
-                     hist_fn=None, split_fn=None):
-    """Build a jitted ``grow(bins, grad, hess, sample_mask, feature_mask)``.
+                     hist_fn=None, split_fn=None, col_fn=None,
+                     reduce_fn=None, jit=True):
+    """Build a ``grow(bins, grad, hess, sample_mask, feature_mask)``.
 
-    ``hist_fn``/``split_fn`` are injection seams for the parallel learners
-    (data-parallel psum of histograms, feature-parallel masking — SURVEY
-    §2.2): they default to the local single-device implementations.
+    Injection seams for the parallel learners (SURVEY §2.2):
+      hist_fn(bins, w) -> [F_hist, B, 3]    histogram of one leaf's rows
+        (data-parallel: local hist + psum; feature-parallel: local
+        feature slice only; voting: local hist, election in split_fn)
+      split_fn(hist, sg, sh, nd, fmask, can) -> SplitResult with GLOBAL
+        feature indices (feature-parallel: cross-device argmax; voting:
+        top-k vote + elected psum + argmax)
+      col_fn(bins, feat) -> [N_local] bin column for a global feature id
+      reduce_fn(x) -> global sum of a locally-summed scalar
+        (data/voting-parallel: lax.psum over the data axis)
+
+    All default to the serial single-device implementations. ``jit=False``
+    returns the raw traceable fn for wrapping in shard_map.
     """
     L = cfg.num_leaves
     B = cfg.num_bins
@@ -149,6 +160,12 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
     if split_fn is None:
         def split_fn(hist, sg, sh, nd, fmask, can):
             return find_best_split(hist, sg, sh, nd, fmask, meta, hp, can)
+    if col_fn is None:
+        def col_fn(bins, feat):
+            return jnp.take(bins, feat, axis=1).astype(jnp.int32)
+    if reduce_fn is None:
+        def reduce_fn(x):
+            return x
 
     def depth_ok(depth):
         if cfg.max_depth > 0:
@@ -171,7 +188,6 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             t_right_sum_h=state.t_right_sum_h.at[leaf].set(res.right_sum_h),
         )
 
-    @jax.jit
     def grow(bins, grad, hess, sample_mask, feature_mask):
         """Grow one tree.
 
@@ -188,15 +204,16 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
 
         # root
         root_hist = hist_fn(bins, w)
-        root_g = jnp.sum(grad)
-        root_h = jnp.sum(hess)
-        root_c = jnp.sum(sample_mask)
+        root_g = reduce_fn(jnp.sum(grad))
+        root_h = reduce_fn(jnp.sum(hess))
+        root_c = reduce_fn(jnp.sum(sample_mask))
         root_split = split_fn(root_hist, root_g, root_h, root_c,
                               feature_mask, depth_ok(jnp.int32(0)))
+        F_h = root_hist.shape[0]   # features held in the histogram pool
 
         state = _State(
             leaf_ids=jnp.zeros(n, jnp.int32),
-            hist=jnp.zeros((L, F, B, 3), f32).at[0].set(root_hist),
+            hist=jnp.zeros((L, F_h, B, 3), f32).at[0].set(root_hist),
             t_gain=jnp.full(L, KMIN_SCORE, f32).at[0].set(root_split.gain),
             t_feature=jnp.zeros(L, jnp.int32).at[0].set(root_split.feature),
             t_bin=jnp.zeros(L, jnp.int32).at[0].set(root_split.threshold_bin),
@@ -239,7 +256,7 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
             feat = state.t_feature[leaf]
             tbin = state.t_bin[leaf]
             dleft = state.t_default_left[leaf]
-            bin_col = jnp.take(bins, feat, axis=1).astype(jnp.int32)
+            bin_col = col_fn(bins, feat)
             leaf_ids = apply_split(
                 state.leaf_ids, bin_col, leaf, new, tbin, dleft,
                 meta.missing_type[feat], meta.default_bin[feat],
@@ -362,4 +379,4 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         )
         return rec, state.leaf_ids
 
-    return grow
+    return jax.jit(grow) if jit else grow

@@ -118,17 +118,14 @@ def leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step):
     return leaf_split_gain_given_output(sum_g, sum_h, l1, l2, out)
 
 
-def find_best_split(hist: jax.Array, sum_g, sum_h, num_data,
-                    feature_mask: jax.Array, meta: FeatureMeta,
-                    hp: SplitParams, can_split=True) -> SplitResult:
-    """Find the best (feature, threshold, direction) for one leaf.
+def _candidate_tables(hist: jax.Array, sum_g, sum_h, num_data,
+                      feature_mask: jax.Array, meta: FeatureMeta,
+                      hp: SplitParams, can_split=True):
+    """Gain tables for every (feature, direction, threshold) candidate.
 
-    Args:
-      hist: [F, B, 3] histogram (grad, hess, count).
-      sum_g/sum_h/num_data: leaf totals (scalars; num_data = bagged count).
-      feature_mask: [F] bool — usable features (feature_fraction sampling,
-        trivial-feature exclusion).
-      can_split: scalar bool gate (e.g. max_depth reached) — forces -inf gain.
+    Returns (g2, g1, min_gain_shift, ctx) where g2/g1 are the masked
+    gain tables [F, B] for dir=-1 / dir=+1 and ctx carries the
+    left-accumulation arrays needed to reconstruct a SplitResult.
     """
     f32 = jnp.float32
     F, B, _ = hist.shape
@@ -209,6 +206,41 @@ def find_best_split(hist: jax.Array, sum_g, sum_h, num_data,
     fmask = feature_mask[:, None] & can_split
     g1 = jnp.where(ok1 & fmask, gains1, KMIN_SCORE)
     g2 = jnp.where(ok2 & fmask, gains2, KMIN_SCORE)
+    ctx = dict(l_g1=l_g1, l_h1=l_h1, l_c1=l_c1,
+               l_g2=l_g2, l_h2=l_h2, l_c2=l_c2,
+               sum_g=sum_g, sum_h2=sum_h2, num_data=num_data,
+               two_scan=two_scan, mt=mt, l1=l1, l2=l2, mds=mds)
+    return g2, g1, min_gain_shift, ctx
+
+
+def best_gain_per_feature(hist, sum_g, sum_h, num_data, feature_mask,
+                          meta: FeatureMeta, hp: SplitParams,
+                          can_split=True) -> jax.Array:
+    """Per-feature best split gain [F] (-inf where no valid split) — the
+    local-vote input of the voting-parallel learner
+    (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp:166)."""
+    g2, g1, min_gain_shift, _ = _candidate_tables(
+        hist, sum_g, sum_h, num_data, feature_mask, meta, hp, can_split)
+    best = jnp.maximum(g2.max(axis=1), g1.max(axis=1))
+    return jnp.where(jnp.isfinite(best),
+                     (best - min_gain_shift) * meta.penalty, KMIN_SCORE)
+
+
+def find_best_split(hist: jax.Array, sum_g, sum_h, num_data,
+                    feature_mask: jax.Array, meta: FeatureMeta,
+                    hp: SplitParams, can_split=True) -> SplitResult:
+    """Find the best (feature, threshold, direction) for one leaf.
+
+    Args:
+      hist: [F, B, 3] histogram (grad, hess, count).
+      sum_g/sum_h/num_data: leaf totals (scalars; num_data = bagged count).
+      feature_mask: [F] bool — usable features (feature_fraction sampling,
+        trivial-feature exclusion).
+      can_split: scalar bool gate (e.g. max_depth reached) — forces -inf gain.
+    """
+    F, B, _ = hist.shape
+    g2, g1, min_gain_shift, ctx = _candidate_tables(
+        hist, sum_g, sum_h, num_data, feature_mask, meta, hp, can_split)
 
     # --- argmax with reference tie-break order --------------------------
     # flatten [F, 2, B]: dir=-1 first with REVERSED thresholds (so larger t
@@ -224,15 +256,18 @@ def find_best_split(hist: jax.Array, sum_g, sum_h, num_data,
     t = jnp.where(d == 0, B - 1 - tb, tb)            # undo reversal
 
     is_dir2 = d == 0
-    lg = jnp.where(is_dir2, l_g2[fi, t], l_g1[fi, t])
-    lh = jnp.where(is_dir2, l_h2[fi, t], l_h1[fi, t])
-    lc = jnp.where(is_dir2, l_c2[fi, t], l_c1[fi, t])
+    lg = jnp.where(is_dir2, ctx["l_g2"][fi, t], ctx["l_g1"][fi, t])
+    lh = jnp.where(is_dir2, ctx["l_h2"][fi, t], ctx["l_h1"][fi, t])
+    lc = jnp.where(is_dir2, ctx["l_c2"][fi, t], ctx["l_c1"][fi, t])
+    sum_g = ctx["sum_g"]
+    sum_h2 = ctx["sum_h2"]
+    l1, l2, mds = ctx["l1"], ctx["l2"], ctx["mds"]
     rg = sum_g - lg
     rh = sum_h2 - lh
-    rc = num_data - lc
+    rc = ctx["num_data"] - lc
 
     # single-scan NaN edge: report default_left = False (hpp:103-106)
-    single_nan = (~two_scan[fi]) & (mt[fi] == MISSING_NAN)
+    single_nan = (~ctx["two_scan"][fi]) & (ctx["mt"][fi] == MISSING_NAN)
     default_left = is_dir2 & ~single_nan
 
     has = jnp.isfinite(best_gain)

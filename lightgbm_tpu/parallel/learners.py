@@ -1,0 +1,211 @@
+"""Distributed tree learners over a JAX device mesh.
+
+TPU-native counterparts of the reference's three parallel tree learners
+(reference: src/treelearner/data_parallel_tree_learner.cpp,
+feature_parallel_tree_learner.cpp, voting_parallel_tree_learner.cpp and
+the socket/MPI collective layer they ride on, src/network/network.cpp).
+Instead of hand-rolled Bruck/recursive-halving collectives over TCP, the
+whole tree build runs as ONE ``shard_map`` program over a
+``jax.sharding.Mesh`` and the three communication points lower onto XLA
+collectives over ICI/DCN:
+
+  reference                              here
+  ---------------------------------     ------------------------------
+  histogram ReduceScatter                ``lax.psum`` of leaf histograms
+    (data_parallel_tree_learner.cpp:147)   (data parallel)
+  best-split AllReduce w/ max-gain       ``lax.all_gather`` of the
+    reducer (parallel_tree_learner.h:183)  SplitResult tuple + argmax
+  top-k vote Allgather                   ``lax.all_gather`` of local
+    (voting_parallel_tree_learner.cpp:342) top-k ids + psum vote count
+
+Modes (tree_learner config, config.h tree_learner):
+- data:    rows sharded across devices; per-leaf histograms summed with
+           ``psum``; every device finds the same global best split.
+- feature: every device holds ALL rows (like the reference, where each
+           worker has the full data, feature_parallel_tree_learner.cpp:31);
+           each device builds histograms only for its own feature slice,
+           finds its local best, and the global best is ``all_gather`` +
+           argmax. No row movement at split time.
+- voting:  data-parallel with PV-Tree communication compression: each
+           device votes its local top-k features, the global top-2k by
+           vote count are elected, and ONLY those features' histograms
+           are summed (``psum`` of a [2k, B, 3] slice instead of the
+           full [F, B, 3]).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.grower import GrowerConfig, make_tree_grower
+from ..ops.histogram import build_histogram
+from ..ops.split import (FeatureMeta, SplitResult, best_gain_per_feature,
+                         find_best_split)
+
+AXIS = "workers"
+
+
+def make_mesh(num_devices: Optional[int] = None) -> Mesh:
+    from ..utils.device import get_devices
+    devs = get_devices()
+    n = len(devs) if num_devices is None else min(num_devices, len(devs))
+    return Mesh(np.asarray(devs[:n]), (AXIS,))
+
+
+def sync_best_split(res: SplitResult) -> SplitResult:
+    """Cross-device argmax of per-device best splits — the analog of
+    SyncUpGlobalBestSplit (parallel_tree_learner.h:183-207)."""
+    gathered = jax.lax.all_gather(res, AXIS)      # pytree of [D, ...]
+    best = jnp.argmax(gathered.gain)
+    return SplitResult(*[leaf[best] for leaf in gathered])
+
+
+def _slice_meta(meta: FeatureMeta, start, size: int) -> FeatureMeta:
+    return FeatureMeta(*[
+        jax.lax.dynamic_slice_in_dim(jnp.asarray(a), start, size, 0)
+        for a in meta])
+
+
+def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
+                              mesh: Mesh):
+    """Rows sharded over the mesh; histograms psummed.
+
+    (DataParallelTreeLearner semantics; the reference reduce-scatters so
+    each worker reduces a feature subset — with XLA the psum IS the
+    reduce+broadcast and the compiler picks the wire algorithm.)
+    """
+    B = cfg.num_bins
+
+    def hist_fn(bins, w):
+        local = build_histogram(bins, w, num_bins=B, chunk=cfg.chunk)
+        return jax.lax.psum(local, AXIS)
+
+    def reduce_fn(x):
+        return jax.lax.psum(x, AXIS)
+
+    grow = make_tree_grower(cfg, meta, hist_fn=hist_fn,
+                            reduce_fn=reduce_fn, jit=False)
+    sharded = jax.shard_map(
+        grow, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(None)),
+        out_specs=(P(), P(AXIS)),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
+                                 mesh: Mesh, num_features: int):
+    """Every device holds all rows; feature slice per device for the
+    histogram/split work (FeatureParallelTreeLearner semantics)."""
+    B = cfg.num_bins
+    D = mesh.devices.size
+    if num_features % D != 0:
+        raise ValueError("feature-parallel requires padded features")
+    Fd = num_features // D
+
+    def hist_fn(bins, w):
+        i = jax.lax.axis_index(AXIS)
+        local_bins = jax.lax.dynamic_slice_in_dim(bins, i * Fd, Fd, 1)
+        return build_histogram(local_bins, w, num_bins=B, chunk=cfg.chunk)
+
+    def split_fn(hist, sg, sh, nd, fmask, can):
+        i = jax.lax.axis_index(AXIS)
+        meta_l = _slice_meta(meta, i * Fd, Fd)
+        fmask_l = jax.lax.dynamic_slice_in_dim(fmask, i * Fd, Fd, 0)
+        res = find_best_split(hist, sg, sh, nd, fmask_l, meta_l,
+                              cfg.hp, can)
+        res = res._replace(
+            feature=jnp.where(res.feature >= 0, res.feature + i * Fd, -1))
+        return sync_best_split(res)
+
+    grow = make_tree_grower(cfg, meta, hist_fn=hist_fn, split_fn=split_fn,
+                            jit=False)
+    sharded = jax.shard_map(
+        grow, mesh=mesh,
+        in_specs=(P(None, None), P(None), P(None), P(None), P(None)),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
+                                mesh: Mesh, num_features: int,
+                                top_k: int = 20):
+    """Data-parallel with PV-Tree vote compression
+    (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp:166-360):
+    local top-k vote -> elect 2k global features -> psum only elected
+    histograms."""
+    B = cfg.num_bins
+    D = mesh.devices.size
+    k = max(1, min(top_k, num_features))
+    k2 = min(2 * k, num_features)
+    meta_dev = FeatureMeta(*[jnp.asarray(a) for a in meta])
+    # local-vote gates and totals scaled to the per-device shard, like the
+    # reference's local_config (voting_parallel_tree_learner.cpp:53-55)
+    hp_vote = cfg.hp._replace(
+        min_data_in_leaf=cfg.hp.min_data_in_leaf / D,
+        min_sum_hessian_in_leaf=cfg.hp.min_sum_hessian_in_leaf / D)
+
+    def hist_fn(bins, w):
+        # LOCAL histograms — no psum here; election decides what is summed
+        return build_histogram(bins, w, num_bins=B, chunk=cfg.chunk)
+
+    def reduce_fn(x):
+        return jax.lax.psum(x, AXIS)
+
+    def split_fn(hist, sg, sh, nd, fmask, can):
+        # 1. local per-feature gains over the LOCAL histogram with
+        #    per-shard totals and gates (the reference votes with local
+        #    leaf sumups and num_machines-scaled thresholds,
+        #    voting_parallel_tree_learner.cpp:53-55,151-160)
+        local_gain = best_gain_per_feature(hist, sg / D, sh / D, nd / D,
+                                           fmask, meta_dev, hp_vote, can)
+        _, local_top = jax.lax.top_k(local_gain, k)
+        # 2. global vote: one-hot count of each device's top-k
+        votes = jnp.zeros(num_features, jnp.float32).at[local_top].add(1.0)
+        votes = jax.lax.psum(votes, AXIS)
+        # deterministic tie-break by summed local gain
+        finite_gain = jnp.where(jnp.isfinite(local_gain), local_gain, 0.0)
+        gain_sum = jax.lax.psum(finite_gain, AXIS)
+        score = votes + 1e-6 * jax.nn.sigmoid(gain_sum)
+        _, elected = jax.lax.top_k(score, k2)        # [2k] global ids
+        # 3. aggregate ONLY the elected features' histograms
+        elected_hist = jax.lax.psum(hist[elected], AXIS)   # [2k, B, 3]
+        meta_e = FeatureMeta(*[a[elected] for a in meta_dev])
+        fmask_e = fmask[elected]
+        res = find_best_split(elected_hist, sg, sh, nd, fmask_e, meta_e,
+                              cfg.hp, can)
+        return res._replace(
+            feature=jnp.where(res.feature >= 0, elected[res.feature], -1))
+
+    grow = make_tree_grower(cfg, meta, hist_fn=hist_fn, split_fn=split_fn,
+                            reduce_fn=reduce_fn, jit=False)
+    sharded = jax.shard_map(
+        grow, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(None)),
+        out_specs=(P(), P(AXIS)),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def make_grower_for_mode(mode: str, cfg: GrowerConfig, meta: FeatureMeta,
+                         mesh: Optional[Mesh], num_features: int,
+                         top_k: int = 20):
+    """Factory matching TreeLearner::CreateTreeLearner
+    (src/treelearner/tree_learner.cpp:9-33) — {serial, feature, data,
+    voting} on the tpu device type."""
+    if mode == "serial" or mesh is None or mesh.devices.size == 1:
+        return make_tree_grower(cfg, meta)
+    if mode == "data":
+        return make_data_parallel_grower(cfg, meta, mesh)
+    if mode == "feature":
+        return make_feature_parallel_grower(cfg, meta, mesh, num_features)
+    if mode == "voting":
+        return make_voting_parallel_grower(cfg, meta, mesh, num_features,
+                                           top_k)
+    raise ValueError(f"Unknown tree_learner {mode!r}")

@@ -1,0 +1,21 @@
+"""Device/platform selection.
+
+``jax.devices()`` returns the highest-priority backend (on this image the
+axon TPU plugin registers itself even when ``JAX_PLATFORMS=cpu`` is set).
+``LGBM_TPU_PLATFORM`` selects an explicit backend — tests set it to
+``cpu`` together with ``jax_num_cpu_devices`` to get an 8-device virtual
+mesh for in-process multi-worker coverage.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+
+def get_devices(platform: Optional[str] = None) -> List:
+    plat = platform or os.environ.get("LGBM_TPU_PLATFORM")
+    if plat:
+        return jax.local_devices(backend=plat)
+    return jax.devices()

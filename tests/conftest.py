@@ -7,17 +7,19 @@ Must run before jax is imported anywhere.
 """
 import os
 
-# Force (not setdefault: the axon environment presets JAX_PLATFORMS=axon,
-# and running unit tests over the TPU tunnel makes every host transfer a
-# ~90ms RPC).
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+# The axon TPU plugin on this image registers itself regardless of
+# JAX_PLATFORMS, so jax.devices() returns the (single, tunneled) TPU.
+# Tests run on the true CPU backend with 8 virtual devices instead:
+# LGBM_TPU_PLATFORM routes the framework's device selection
+# (lightgbm_tpu/utils/device.py) and jax_default_device keeps all test
+# computation off the tunnel.
+os.environ["LGBM_TPU_PLATFORM"] = "cpu"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_default_device",
+                  jax.local_devices(backend="cpu")[0])
 # Persistent compile cache: distinct grower shapes compile once per
 # machine, not once per pytest run.
 jax.config.update("jax_compilation_cache_dir", "/tmp/lgbm_tpu_jax_cache")

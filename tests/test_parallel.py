@@ -1,0 +1,103 @@
+"""Distributed tree learner tests on the 8-device virtual CPU mesh —
+the in-process multi-worker coverage the reference never had
+(SURVEY.md §4.4: the reference's parallel learners are only exercised
+manually via examples/parallel_learning)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.utils.device import get_devices
+
+from conftest import fit_gbdt, make_binary, make_regression
+
+pytestmark = pytest.mark.skipif(
+    len(get_devices()) < 2, reason="needs multi-device mesh")
+
+
+def _auc(g):
+    return dict((n, v) for n, v, _ in g.get_eval_at(0))["auc"]
+
+
+@pytest.fixture(scope="module")
+def serial_binary():
+    X, y = make_binary()
+    g = fit_gbdt(X, y, {"objective": "binary", "metric": "auc"},
+                 num_round=15)
+    return g, X, y
+
+
+class TestDataParallel:
+    def test_matches_serial(self, serial_binary):
+        gs, X, y = serial_binary
+        gd = fit_gbdt(X, y, {"objective": "binary", "metric": "auc",
+                             "tree_learner": "data"}, num_round=15)
+        assert gd._learner_mode == "data"
+        # identical data + deterministic splits -> identical models
+        np.testing.assert_allclose(
+            gd.predict_raw(X[:200]), gs.predict_raw(X[:200]),
+            rtol=1e-4, atol=1e-4)
+
+    def test_quality(self):
+        X, y = make_binary(1282)  # deliberately not divisible by 8
+        g = fit_gbdt(X, y, {"objective": "binary", "metric": "auc",
+                            "tree_learner": "data"}, num_round=15)
+        assert _auc(g) > 0.97
+
+
+class TestFeatureParallel:
+    def test_matches_serial(self, serial_binary):
+        gs, X, y = serial_binary
+        gf = fit_gbdt(X, y, {"objective": "binary", "metric": "auc",
+                             "tree_learner": "feature"}, num_round=15)
+        assert gf._learner_mode == "feature"
+        np.testing.assert_allclose(
+            gf.predict_raw(X[:200]), gs.predict_raw(X[:200]),
+            rtol=1e-4, atol=1e-4)
+
+
+class TestVotingParallel:
+    def test_quality(self):
+        # voting is an approximation (top-k election) — assert quality,
+        # not exact equality with serial
+        X, y = make_binary()
+        g = fit_gbdt(X, y, {"objective": "binary", "metric": "auc",
+                            "tree_learner": "voting", "top_k": 5},
+                     num_round=15)
+        assert g._learner_mode == "voting"
+        assert _auc(g) > 0.95
+
+    def test_elects_signal_features(self):
+        X, y = make_binary()
+        g = fit_gbdt(X, y, {"objective": "binary",
+                            "tree_learner": "voting", "top_k": 3},
+                     num_round=15)
+        imp = g.feature_importance("split")
+        assert imp[:4].sum() > imp[4:].sum()
+
+
+class TestRegressionParallel:
+    def test_data_parallel_l2(self):
+        X, y = make_regression()
+        g = fit_gbdt(X, y, {"objective": "regression", "metric": "l2",
+                            "tree_learner": "data"}, num_round=20)
+        (_, l2, _), = g.get_eval_at(0)
+        assert l2 < 0.4 * np.var(y)
+
+    def test_data_parallel_l1_odd_rows(self):
+        # regression: padded mask + leaf renewal with n % devices != 0
+        r = np.random.default_rng(11)
+        X = r.normal(size=(1283, 6))
+        y = (2 * X[:, 0] + 0.1 * r.normal(size=1283)).astype(np.float32)
+        g = fit_gbdt(X, y, {"objective": "regression_l1", "metric": "l1",
+                            "tree_learner": "data"}, num_round=8)
+        (_, l1, _), = g.get_eval_at(0)
+        assert l1 < np.mean(np.abs(y - np.median(y)))
+
+
+class TestSerialFallback:
+    def test_single_machine_requested(self):
+        X, y = make_binary(640)
+        g = fit_gbdt(X, y, {"objective": "binary",
+                            "tree_learner": "data", "num_machines": 1},
+                     num_round=3)
+        # num_machines=1 -> mesh over all local devices still engages
+        assert g._learner_mode == "data"
