@@ -1,0 +1,109 @@
+"""Benchmark: HIGGS-class GBDT training throughput on one chip.
+
+Mirrors the reference's headline experiment (docs/Experiments.rst:104-113:
+LightGBM CPU trains HIGGS — 11M rows x 28 features, 500 iterations,
+num_leaves=255 — in 238.5 s on a 2x E5-2670v3 box; the GPU docs recommend
+max_bin=63 for device runs, docs/GPU-Performance.rst:111-127). HIGGS
+itself cannot be downloaded here (no egress), so an equally-sized
+synthetic binary task with the same shape parameters is used and the
+result is normalized to row-iterations/second for comparison against the
+published reference wall-clock.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline > 1.0 means faster than the reference's published HIGGS
+CPU number (its strongest in-repo headline baseline).
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+# reference headline: 11M rows x 500 iters in 238.5 s  (Experiments.rst)
+BASELINE_ROWS = 11_000_000
+BASELINE_ITERS = 500
+BASELINE_SECONDS = 238.5
+BASELINE_ROW_ITERS_PER_S = BASELINE_ROWS * BASELINE_ITERS / BASELINE_SECONDS
+
+
+def make_higgs_like(n_rows: int, n_features: int = 28, seed: int = 7):
+    """Synthetic HIGGS-shaped task: 28 continuous features, nonlinear
+    decision boundary, balanced classes."""
+    r = np.random.default_rng(seed)
+    X = r.normal(size=(n_rows, n_features)).astype(np.float32)
+    logit = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] - 0.3 * X[:, 3] * X[:, 4]
+             + 0.2 * np.abs(X[:, 5]) + 0.1 * X[:, 6])
+    y = (logit + 0.5 * r.normal(size=n_rows) > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--iters", type=int, default=500)
+    ap.add_argument("--leaves", type=int, default=255)
+    ap.add_argument("--max-bin", type=int, default=63)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny smoke run (64k rows, 20 iters)")
+    args = ap.parse_args()
+    if args.quick:
+        args.rows, args.iters, args.leaves = 65_536, 20, 63
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import TpuDataset, Metadata
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.metrics import create_metrics
+
+    t0 = time.time()
+    X, y = make_higgs_like(args.rows)
+    print(f"# data gen: {time.time()-t0:.1f}s", file=sys.stderr)
+
+    cfg = Config().set({
+        "objective": "binary", "metric": "auc",
+        "num_leaves": args.leaves, "max_bin": args.max_bin,
+        "learning_rate": 0.1, "min_data_in_leaf": 20,
+        # run every iteration on device; no periodic host sync inside
+        "tpu_stop_check_interval": 10_000,
+    })
+    t0 = time.time()
+    ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+    obj = create_objective("binary", cfg)
+    obj.init(ds.metadata, ds.num_data)
+    mets = create_metrics(["auc"], cfg, ds.metadata, ds.num_data)
+    g = GBDT()
+    g.init(cfg, ds, obj, mets)
+    print(f"# binning+init: {time.time()-t0:.1f}s", file=sys.stderr)
+
+    # one warm-up iteration compiles the grower
+    t0 = time.time()
+    g.train_one_iter()
+    print(f"# compile+iter0: {time.time()-t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    for _ in range(args.iters - 1):
+        g.train_one_iter()
+    # force completion of the async stream before stopping the clock
+    import jax
+    jax.block_until_ready(g._scores)
+    train_s = time.time() - t0
+    (_, auc, _), = g.get_eval_at(0)
+    print(f"# {args.iters} iters in {train_s:.1f}s  train-AUC={auc:.5f}",
+          file=sys.stderr)
+
+    row_iters_per_s = args.rows * (args.iters - 1) / max(train_s, 1e-9)
+    result = {
+        "metric": ("HIGGS-class GBDT training throughput "
+                   f"({args.rows} rows x 28 feat, {args.leaves} leaves, "
+                   f"{args.max_bin} bins, {args.iters} iters, 1 chip)"),
+        "value": round(row_iters_per_s / 1e6, 3),
+        "unit": "M row-iters/s",
+        "vs_baseline": round(row_iters_per_s / BASELINE_ROW_ITERS_PER_S, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
