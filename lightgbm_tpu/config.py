@@ -255,6 +255,10 @@ class Config:
     tpu_use_dp: bool = True          # fp32 (True) vs bf16 (False) hist accumulation
     tpu_hist_chunk: int = 16384      # rows per on-device histogram chunk
     tpu_donate_buffers: bool = True
+    # leaves split per device step (ops/wave_grower.py): one wave
+    # histogram pass serves this many leaves at once. 1 = exact
+    # reference leaf-wise order; 0 = auto (Pallas kernel channel cap).
+    tpu_wave_size: int = 0
     # iterations between host checks for the "no more splits" stop
     # (gbdt.cpp:393-409); device→host reads are high-latency, so the stop
     # is detected periodically instead of every iteration

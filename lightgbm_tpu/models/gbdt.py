@@ -38,10 +38,10 @@ from ..config import Config
 from ..io.dataset import TpuDataset
 from ..metrics import Metric
 from ..objectives import ObjectiveFunction
-from ..ops.grower import (GrowerConfig, make_tree_grower, pack_record,
-                          unpack_record)
+from ..ops.grower import pack_record, unpack_record
 from ..ops.predict import add_leaf_outputs, replay_partition
 from ..ops.split import SplitParams
+from ..ops.wave_grower import WaveGrowerConfig
 from ..utils import log
 from .tree import Tree, tree_from_record
 
@@ -99,12 +99,13 @@ class GBDT:
         self._n = n
         self._meta = train_data.feature_meta()
         self._setup_grower()
-        bins = train_data.bins
+        # feature-major device layout [F, N] (ops/hist_wave.py)
+        bins_t = np.ascontiguousarray(train_data.bins.T)
         if self._pad_rows:
-            bins = np.pad(bins, ((0, self._pad_rows), (0, 0)))
+            bins_t = np.pad(bins_t, ((0, 0), (0, self._pad_rows)))
         if self._pad_features:
-            bins = np.pad(bins, ((0, 0), (0, self._pad_features)))
-        self._bins_dev = jnp.asarray(bins)
+            bins_t = np.pad(bins_t, ((0, self._pad_features), (0, 0)))
+        self._bins_dev = jnp.asarray(bins_t)
         self._full_mask_dev = jnp.asarray(np.concatenate(
             [np.ones(self._n, np.float32),
              np.zeros(self._pad_rows, np.float32)]))
@@ -171,14 +172,16 @@ class GBDT:
         self._n_pad = self._n + self._pad_rows
         self._f_pad = f + self._pad_features
 
-        # depth cap: reference grows leaf-wise; max_depth bounds node depth
-        local_rows = self._n_pad // D if mode in ("data", "voting") \
-            else self._n_pad
-        gcfg = GrowerConfig(
+        # wave size: leaves split per device step (ops/wave_grower.py);
+        # 0 = auto (the Pallas kernel's hi/lo channel cap)
+        W = cfg.tpu_wave_size or 25
+        W = max(1, min(W, max(cfg.num_leaves, 2) - 1))
+        gcfg = WaveGrowerConfig(
             num_leaves=max(cfg.num_leaves, 2),
             num_bins=self.train_data.max_bin_global,
+            wave_size=W,
             max_depth=cfg.max_depth,
-            chunk=min(cfg.tpu_hist_chunk, _round_up(local_rows, 128)),
+            chunk=0,
             hp=hp)
         self._grower_cfg = gcfg
         self._grower = make_grower_for_mode(
@@ -207,7 +210,7 @@ class GBDT:
         self._valid_scores.append(jnp.asarray(init))
         # replay existing model on the new valid set (bins cached on device
         # once — uploads are cheap, downloads are not)
-        vb = jnp.asarray(valid_data.bins)
+        vb = jnp.asarray(np.ascontiguousarray(valid_data.bins.T))
         self._valid_bins_dev.append(vb)
         for t_idx, rec in enumerate(self.records):
             cls = t_idx % self.num_tree_per_iteration
@@ -548,13 +551,14 @@ class GBDT:
         return out[0] if k == 1 else out.T
 
     def _bin_input(self, X: np.ndarray) -> np.ndarray:
+        """Bin raw rows with the train mappers -> [F, N] feature-major."""
         ds = self.train_data
         f = max(ds.num_features, 1)
         dtype = np.uint8 if ds.max_bin_global <= 256 else np.int32
-        bins = np.zeros((X.shape[0], f), dtype)
+        bins_t = np.zeros((f, X.shape[0]), dtype)
         for i, real in enumerate(ds.used_feature_map):
-            bins[:, i] = ds.mappers[i].value_to_bin(X[:, real]).astype(dtype)
-        return bins
+            bins_t[i] = ds.mappers[i].value_to_bin(X[:, real]).astype(dtype)
+        return bins_t
 
     def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         raw = self.predict_raw(X, num_iteration)

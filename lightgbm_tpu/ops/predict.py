@@ -19,12 +19,14 @@ from .split import FeatureMeta
 
 
 @jax.jit
-def replay_partition(rec, bins, meta: FeatureMeta):
-    """Assign each row of ``bins`` [N, F] to a leaf of the recorded tree by
-    replaying its splits (Tree numbering: split i's right child = leaf i+1).
+def replay_partition(rec, bins_t, meta: FeatureMeta):
+    """Assign each row of ``bins_t`` [F, N] (feature-major) to a leaf of
+    the recorded tree by replaying its splits (Tree numbering: split i's
+    right child = leaf i+1 — the wave grower's new-id assignment keeps
+    this invariant, ops/wave_grower.py).
     """
     meta = FeatureMeta(*[jnp.asarray(x) for x in meta])
-    n = bins.shape[0]
+    n = bins_t.shape[1]
     num_splits = rec.split_leaf.shape[0]
     leaf_ids = jnp.zeros(n, jnp.int32)
 
@@ -32,7 +34,7 @@ def replay_partition(rec, bins, meta: FeatureMeta):
         feat = rec.split_feature[i]
         enabled = rec.split_leaf[i] >= 0
         safe_feat = jnp.maximum(feat, 0)
-        bin_col = jnp.take(bins, safe_feat, axis=1).astype(jnp.int32)
+        bin_col = bins_t[safe_feat].astype(jnp.int32)
         return apply_split(
             leaf_ids, bin_col, rec.split_leaf[i], i + 1, rec.split_bin[i],
             rec.split_default_left[i], meta.missing_type[safe_feat],
@@ -48,11 +50,12 @@ def add_leaf_outputs(scores, leaf_ids, leaf_output, shrinkage):
     return scores + shrinkage * leaf_output[leaf_ids]
 
 
-def predict_trees_binned(records, bins, meta: FeatureMeta, shrinkage_done=True):
+def predict_trees_binned(records, bins_t, meta: FeatureMeta,
+                         shrinkage_done=True):
     """Sum of leaf outputs over a list of TreeRecords for binned rows."""
-    n = bins.shape[0]
+    n = bins_t.shape[1]
     out = jnp.zeros(n, jnp.float32)
     for rec in records:
-        leaf = replay_partition(rec, bins, meta)
+        leaf = replay_partition(rec, bins_t, meta)
         out = out + rec.leaf_output[leaf]
     return out

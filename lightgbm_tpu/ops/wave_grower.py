@@ -1,0 +1,385 @@
+"""Wave-batched on-device leaf-wise tree grower.
+
+TPU-native counterpart of SerialTreeLearner::Train (reference:
+src/treelearner/serial_tree_learner.cpp:157-221), round-2 redesign.
+
+Round 1 compiled the whole leaf-wise loop as ``num_leaves - 1``
+shape-static steps, each paying one full-data histogram pass for ONE
+leaf — O(N * L) row-histogram work per tree. The reference avoids that
+with smaller-child construction + subtraction, but its per-split
+histogram still touches the split leaf's rows via gather — a
+random-access pattern TPUs do poorly.
+
+The round-2 answer is the **wave**: one ``lax.while_loop`` step splits
+the top-``W`` leaves by gain simultaneously, and ONE full-data Pallas
+pass (ops/hist_wave.py) produces all ``W`` smaller-child histograms at
+the cost of one pass — the idle MXU output lanes of a single-leaf pass
+carry the other leaves' channels. Sibling histograms come from
+parent - smaller subtraction (feature_histogram.hpp:68) out of a
+preallocated HBM pool. Row-histogram work per tree drops to
+O(N * L / W), a ~W x win, with no gathers anywhere.
+
+``wave_size=1`` reproduces the reference's exact leaf-wise semantics
+(split strictly one best leaf at a time). For larger W the tree can
+differ from strict leaf-wise only when the leaf budget runs out
+mid-wave; quality is leaf-wise-grade because waves split in gain order.
+
+Leaf numbering matches Tree::Split: each split's left child keeps the
+parent's leaf index, the right child takes the next free index; within
+a wave, new indices are assigned in gain-rank order.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .grower import TreeRecord
+from .hist_wave import wave_histogram
+from .partition import row_goes_right
+from .split import (FeatureMeta, SplitParams, SplitResult, KMIN_SCORE,
+                    calculate_leaf_output, find_best_split)
+
+
+class WaveGrowerConfig(NamedTuple):
+    """Static compile-time configuration of one wave grower."""
+    num_leaves: int
+    num_bins: int          # padded global B
+    wave_size: int = 16
+    max_depth: int = -1
+    chunk: int = 0         # rows per kernel step (0 = impl default)
+    hp: SplitParams = SplitParams()
+    use_pallas: bool | None = None   # None = auto by backend
+
+
+class _State(NamedTuple):
+    leaf_ids: jax.Array        # [N]
+    hist: jax.Array            # [L, F_hist, B, 3] pool
+    # per-leaf best-split table (SplitResult fields, [L] each)
+    t_gain: jax.Array
+    t_feature: jax.Array
+    t_bin: jax.Array
+    t_default_left: jax.Array
+    t_left_output: jax.Array
+    t_right_output: jax.Array
+    t_left_count: jax.Array
+    t_right_count: jax.Array
+    t_left_sum_g: jax.Array
+    t_left_sum_h: jax.Array
+    t_right_sum_g: jax.Array
+    t_right_sum_h: jax.Array
+    # per-leaf aggregates
+    leaf_output: jax.Array
+    leaf_count: jax.Array
+    leaf_sum_g: jax.Array
+    leaf_sum_h: jax.Array
+    leaf_depth: jax.Array
+    num_leaves: jax.Array      # scalar int32
+    n_splits: jax.Array        # scalar int32 (= num_leaves - 1)
+    go_on: jax.Array           # scalar bool
+    rec: TreeRecord
+
+
+def _store_batch(table, idx, vals, active):
+    """Masked scatter of per-slot values into a table.
+
+    Inactive slots are sent to index ``len(table)`` — out of bounds HIGH,
+    which ``mode="drop"`` discards. (A -1 sentinel would NOT be dropped:
+    jax wraps negative scatter indices python-style, silently writing the
+    last element.)
+    """
+    idx = jnp.where(active, idx, table.shape[0])
+    return table.at[idx].set(vals, mode="drop")
+
+
+def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
+                     hist_fn=None, split_fn=None, partition_fn=None,
+                     reduce_fn=None, jit=True):
+    """Build ``grow(bins_t, grad, hess, sample_mask, feature_mask)``.
+
+    bins_t is FEATURE-MAJOR [F, N] (see ops/hist_wave.py).
+
+    Injection seams for the parallel learners (SURVEY §2.2):
+      hist_fn(bins_t, g, h, leaf_ids, wave_leaves) -> [W, F_hist, B, 3]
+        (data-parallel: local wave hist + psum; feature-parallel: local
+        feature slice; voting: local hist, election in split_fn)
+      split_fn(hists [M,F,B,3], sg [M], sh [M], nd [M], fmask, can [M])
+        -> SplitResult of [M] arrays with GLOBAL feature indices
+      partition_fn(bins_t, leaf_ids, wl, new_ids, feat, tbin, dleft,
+                   active) -> new leaf_ids  (local rows)
+      reduce_fn(x) -> global sum of a locally-summed scalar
+
+    All default to serial single-device implementations. ``jit=False``
+    returns the raw traceable fn for wrapping in shard_map.
+    """
+    L = cfg.num_leaves
+    W = min(cfg.wave_size, max(L - 1, 1))
+    B = cfg.num_bins
+    hp = cfg.hp
+    meta = FeatureMeta(*[jnp.asarray(x) for x in meta])
+
+    if hist_fn is None:
+        def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
+            return wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
+                                  num_bins=B, chunk=cfg.chunk,
+                                  use_pallas=cfg.use_pallas)
+
+    if split_fn is None:
+        def split_fn(hists, sg, sh, nd, fmask, can):
+            return jax.vmap(
+                lambda hh, a, b, c, d: find_best_split(
+                    hh, a, b, c, fmask, meta, hp, d)
+            )(hists, sg, sh, nd, can)
+
+    if partition_fn is None:
+        def partition_fn(bins_t, leaf_ids, wl, new_ids, feat, tbin,
+                         dleft, active):
+            return apply_wave_splits(bins_t, leaf_ids, wl, new_ids, feat,
+                                     tbin, dleft, active, meta)
+
+    if reduce_fn is None:
+        def reduce_fn(x):
+            return x
+
+    def depth_ok(depth):
+        if cfg.max_depth > 0:
+            return depth < cfg.max_depth
+        return jnp.ones_like(depth, dtype=bool)
+
+    def grow(bins_t, grad, hess, sample_mask, feature_mask):
+        """Grow one tree.
+
+        bins_t: [F, N] int bins (feature-major); grad/hess: [N] f32;
+        sample_mask: [N] f32 0/1 bagging membership;
+        feature_mask: [F] bool usable features this tree.
+        Returns (TreeRecord, leaf_ids[N]) — leaf_ids of ALL rows
+        (out-of-bag included) for score updates.
+        """
+        F, n = bins_t.shape
+        f32 = jnp.float32
+        grad = grad.astype(f32) * sample_mask
+        hess = hess.astype(f32) * sample_mask
+        in_bag = sample_mask > 0
+
+        # Bagging: leaf_ids tracks ALL rows (out-of-bag rows partition
+        # too — scores need their leaf), but histogram passes see
+        # out-of-bag rows as leaf -1 so no wave slot counts them.
+        def bag_mask_ids(leaf_ids):
+            return jnp.where(in_bag, leaf_ids, -1)
+
+        # root: wave histogram with one active slot = leaf 0
+        root_wl = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.full(W - 1, -1, jnp.int32)])
+        leaf0 = jnp.zeros(n, jnp.int32)
+        root_hist = hist_fn(bins_t, grad, hess, bag_mask_ids(leaf0),
+                            root_wl)                     # [W, F, B, 3]
+        F_h = root_hist.shape[1]
+        root_g = reduce_fn(jnp.sum(grad))
+        root_h = reduce_fn(jnp.sum(hess))
+        root_c = reduce_fn(jnp.sum(sample_mask))
+        root_split = split_fn(
+            root_hist[:1], root_g[None], root_h[None], root_c[None],
+            feature_mask, depth_ok(jnp.zeros(1, jnp.int32)))
+
+        def set0(arr, v):
+            return arr.at[0].set(v[0] if v.ndim else v)
+
+        state = _State(
+            leaf_ids=leaf0,
+            hist=jnp.zeros((L, F_h, B, 3), f32).at[0].set(root_hist[0]),
+            t_gain=set0(jnp.full(L, KMIN_SCORE, f32), root_split.gain),
+            t_feature=set0(jnp.zeros(L, jnp.int32), root_split.feature),
+            t_bin=set0(jnp.zeros(L, jnp.int32), root_split.threshold_bin),
+            t_default_left=set0(jnp.zeros(L, bool),
+                                root_split.default_left),
+            t_left_output=set0(jnp.zeros(L, f32), root_split.left_output),
+            t_right_output=set0(jnp.zeros(L, f32),
+                                root_split.right_output),
+            t_left_count=set0(jnp.zeros(L, f32), root_split.left_count),
+            t_right_count=set0(jnp.zeros(L, f32), root_split.right_count),
+            t_left_sum_g=set0(jnp.zeros(L, f32), root_split.left_sum_g),
+            t_left_sum_h=set0(jnp.zeros(L, f32), root_split.left_sum_h),
+            t_right_sum_g=set0(jnp.zeros(L, f32), root_split.right_sum_g),
+            t_right_sum_h=set0(jnp.zeros(L, f32), root_split.right_sum_h),
+            leaf_output=jnp.zeros(L, f32),
+            leaf_count=jnp.zeros(L, f32).at[0].set(root_c),
+            leaf_sum_g=jnp.zeros(L, f32).at[0].set(root_g),
+            leaf_sum_h=jnp.zeros(L, f32).at[0].set(root_h),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            num_leaves=jnp.int32(1),
+            n_splits=jnp.int32(0),
+            go_on=jnp.bool_(True),
+            rec=TreeRecord(
+                num_leaves=jnp.int32(1),
+                split_leaf=jnp.full(L - 1, -1, jnp.int32),
+                split_feature=jnp.full(L - 1, -1, jnp.int32),
+                split_bin=jnp.zeros(L - 1, jnp.int32),
+                split_gain=jnp.zeros(L - 1, f32),
+                split_default_left=jnp.zeros(L - 1, bool),
+                leaf_output=jnp.zeros(L, f32),
+                leaf_count=jnp.zeros(L, f32),
+                leaf_sum_g=jnp.zeros(L, f32),
+                leaf_sum_h=jnp.zeros(L, f32),
+                internal_value=jnp.zeros(L - 1, f32),
+                internal_count=jnp.zeros(L - 1, f32),
+            ),
+        )
+
+        def body(state: _State) -> _State:
+            f32 = jnp.float32
+            # 1. elect the wave: top-W leaves by gain, capped by budget
+            top_gain, wl = jax.lax.top_k(state.t_gain, W)   # [W]
+            wl = wl.astype(jnp.int32)
+            budget = (L - state.num_leaves).astype(jnp.int32)
+            rank = jnp.arange(W, dtype=jnp.int32)
+            active = (top_gain > 0.0) & (rank < budget)
+            n_act = jnp.sum(active.astype(jnp.int32))
+            prefix = jnp.cumsum(active.astype(jnp.int32)) - active
+            new_ids = jnp.where(active, state.num_leaves + prefix, -1)
+            wl = jnp.where(active, wl, -1)
+            # scatter-safe slot indices: OOB-high sentinel so that
+            # mode="drop" really drops inactive slots (negative indices
+            # would wrap python-style and corrupt the last entries)
+            wl_s = jnp.where(active, wl, L)
+            new_s = jnp.where(active, new_ids, L)
+
+            # 2. per-slot split params from the table (drop-safe gathers)
+            feat = state.t_feature[wl]
+            tbin = state.t_bin[wl]
+            dleft = state.t_default_left[wl]
+            lcnt = state.t_left_count[wl]
+            rcnt = state.t_right_count[wl]
+            lg, lh = state.t_left_sum_g[wl], state.t_left_sum_h[wl]
+            rg, rh = state.t_right_sum_g[wl], state.t_right_sum_h[wl]
+            lo, ro = state.t_left_output[wl], state.t_right_output[wl]
+
+            # 3. partition: apply all wave splits in one pass
+            leaf_ids = partition_fn(bins_t, state.leaf_ids, wl, new_ids,
+                                    feat, tbin, dleft, active)
+
+            # 4. smaller-child histograms in ONE wave pass; siblings by
+            #    subtraction from the pooled parent histogram
+            left_smaller = lcnt <= rcnt
+            small_ids = jnp.where(left_smaller, wl, new_ids)
+            small_ids = jnp.where(active, small_ids, -1)
+            hist_small = hist_fn(bins_t, grad, hess,
+                                 bag_mask_ids(leaf_ids), small_ids)
+            parent_hist = state.hist[wl]                 # [W, F, B, 3]
+            hist_large = parent_hist - hist_small
+            ls4 = left_smaller[:, None, None, None]
+            hist_left = jnp.where(ls4, hist_small, hist_large)
+            hist_right = jnp.where(ls4, hist_large, hist_small)
+            pool = state.hist
+            pool = pool.at[wl_s].set(hist_left, mode="drop")
+            pool = pool.at[new_s].set(hist_right, mode="drop")
+
+            # 5. record the wave's splits at positions n_splits + prefix
+            pos = jnp.where(active, state.n_splits + prefix, L - 1)
+            parent_out = calculate_leaf_output(
+                state.leaf_sum_g[wl], state.leaf_sum_h[wl],
+                hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)
+            rec = state.rec
+            rec = rec._replace(
+                num_leaves=rec.num_leaves + n_act,
+                split_leaf=rec.split_leaf.at[pos].set(wl, mode="drop"),
+                split_feature=rec.split_feature.at[pos].set(
+                    feat, mode="drop"),
+                split_bin=rec.split_bin.at[pos].set(tbin, mode="drop"),
+                split_gain=rec.split_gain.at[pos].set(
+                    jnp.where(active, top_gain, 0.0), mode="drop"),
+                split_default_left=rec.split_default_left.at[pos].set(
+                    dleft, mode="drop"),
+                internal_value=rec.internal_value.at[pos].set(
+                    parent_out, mode="drop"),
+                internal_count=rec.internal_count.at[pos].set(
+                    state.leaf_count[wl], mode="drop"),
+            )
+
+            # 6. per-leaf aggregate updates (left child keeps parent id)
+            child_depth = state.leaf_depth[wl] + 1
+
+            def upd(arr, lvals, rvals):
+                arr = arr.at[wl_s].set(lvals, mode="drop")
+                return arr.at[new_s].set(rvals, mode="drop")
+
+            leaf_output = upd(state.leaf_output, lo, ro)
+            leaf_count = upd(state.leaf_count, lcnt, rcnt)
+            leaf_sum_g = upd(state.leaf_sum_g, lg, rg)
+            leaf_sum_h = upd(state.leaf_sum_h, lh, rh)
+            leaf_depth = upd(state.leaf_depth, child_depth, child_depth)
+
+            # 7. best splits for the 2W children
+            hists2 = jnp.concatenate([hist_left, hist_right], axis=0)
+            sg2 = jnp.concatenate([lg, rg])
+            sh2 = jnp.concatenate([lh, rh])
+            nd2 = jnp.concatenate([lcnt, rcnt])
+            can2 = jnp.concatenate([active & depth_ok(child_depth)] * 2)
+            res = split_fn(hists2, sg2, sh2, nd2, feature_mask, can2)
+            gain2 = jnp.where(jnp.isfinite(res.gain), res.gain,
+                              KMIN_SCORE)
+            idx2 = jnp.concatenate([wl_s, new_s])
+            act2 = jnp.concatenate([active] * 2)
+
+            st = lambda tbl, v: _store_batch(tbl, idx2, v, act2)
+            state = state._replace(
+                leaf_ids=leaf_ids,
+                hist=pool,
+                t_gain=st(state.t_gain, gain2),
+                t_feature=st(state.t_feature, res.feature),
+                t_bin=st(state.t_bin, res.threshold_bin),
+                t_default_left=st(state.t_default_left, res.default_left),
+                t_left_output=st(state.t_left_output, res.left_output),
+                t_right_output=st(state.t_right_output, res.right_output),
+                t_left_count=st(state.t_left_count, res.left_count),
+                t_right_count=st(state.t_right_count, res.right_count),
+                t_left_sum_g=st(state.t_left_sum_g, res.left_sum_g),
+                t_left_sum_h=st(state.t_left_sum_h, res.left_sum_h),
+                t_right_sum_g=st(state.t_right_sum_g, res.right_sum_g),
+                t_right_sum_h=st(state.t_right_sum_h, res.right_sum_h),
+                leaf_output=leaf_output,
+                leaf_count=leaf_count,
+                leaf_sum_g=leaf_sum_g,
+                leaf_sum_h=leaf_sum_h,
+                leaf_depth=leaf_depth,
+                num_leaves=state.num_leaves + n_act,
+                n_splits=state.n_splits + n_act,
+                go_on=(n_act > 0) & (state.num_leaves + n_act < L),
+                rec=rec,
+            )
+            return state
+
+        state = jax.lax.while_loop(lambda s: s.go_on, body, state)
+        rec = state.rec._replace(
+            leaf_output=state.leaf_output,
+            leaf_count=state.leaf_count,
+            leaf_sum_g=state.leaf_sum_g,
+            leaf_sum_h=state.leaf_sum_h,
+        )
+        return rec, state.leaf_ids
+
+    return jax.jit(grow) if jit else grow
+
+
+def apply_wave_splits(bins_t, leaf_ids, wl, new_ids, feat, tbin, dleft,
+                      active, meta: FeatureMeta):
+    """Apply up to W splits to the row partition in one fused pass.
+
+    For each wave slot k: rows with ``leaf_ids == wl[k]`` whose binned
+    feature value goes right move to ``new_ids[k]``
+    (DataPartition::Split + Bin::Split semantics,
+    src/treelearner/data_partition.hpp:109-166).
+    """
+    W = wl.shape[0]
+    out = leaf_ids
+    for k in range(W):
+        col = bins_t[feat[k]]                    # [N] dynamic row slice
+        right = row_goes_right(
+            col.astype(jnp.int32), tbin[k], dleft[k],
+            meta.missing_type[feat[k]], meta.default_bin[feat[k]],
+            meta.num_bin[feat[k]])
+        move = (leaf_ids == wl[k]) & right & active[k]
+        out = jnp.where(move, new_ids[k], out)
+    return out

@@ -11,30 +11,35 @@ collectives over ICI/DCN:
 
   reference                              here
   ---------------------------------     ------------------------------
-  histogram ReduceScatter                ``lax.psum`` of leaf histograms
-    (data_parallel_tree_learner.cpp:147)   (data parallel)
+  histogram ReduceScatter                ``lax.psum`` of the wave's
+    (data_parallel_tree_learner.cpp:147)   [W, F, B, 3] histograms
   best-split AllReduce w/ max-gain       ``lax.all_gather`` of the
-    reducer (parallel_tree_learner.h:183)  SplitResult tuple + argmax
-  top-k vote Allgather                   ``lax.all_gather`` of local
-    (voting_parallel_tree_learner.cpp:342) top-k ids + psum vote count
+    reducer (parallel_tree_learner.h:183)  per-child SplitResult batch
+                                           + per-child argmax
+  top-k vote Allgather                   ``lax.psum`` of one-hot votes
+    (voting_parallel_tree_learner.cpp:342) + elected-feature psum
+
+All modes drive the round-2 wave grower (ops/wave_grower.py): a wave of
+up to W leaves is split per step and ONE wave-histogram pass feeds every
+mode's collective, so the communication volume per step is W leaves'
+histograms instead of one — the same batching win as on-device compute.
 
 Modes (tree_learner config, config.h tree_learner):
-- data:    rows sharded across devices; per-leaf histograms summed with
-           ``psum``; every device finds the same global best split.
+- data:    rows sharded across devices; wave histograms psummed; every
+           device computes the same global best splits.
 - feature: every device holds ALL rows (like the reference, where each
            worker has the full data, feature_parallel_tree_learner.cpp:31);
-           each device builds histograms only for its own feature slice,
-           finds its local best, and the global best is ``all_gather`` +
-           argmax. No row movement at split time.
+           each device builds wave histograms only for its own feature
+           slice, finds local bests, and the global best per child is
+           all_gather + argmax. No row movement at split time.
 - voting:  data-parallel with PV-Tree communication compression: each
-           device votes its local top-k features, the global top-2k by
-           vote count are elected, and ONLY those features' histograms
-           are summed (``psum`` of a [2k, B, 3] slice instead of the
-           full [F, B, 3]).
+           device votes its local top-k features per child, the global
+           top-2k by vote count are elected, and ONLY those features'
+           histograms are summed (``psum`` of a [2W, 2k, B, 3] slice
+           instead of the full [2W, F, B, 3]).
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -42,10 +47,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.grower import GrowerConfig, make_tree_grower
-from ..ops.histogram import build_histogram
+from ..ops.hist_wave import wave_histogram
 from ..ops.split import (FeatureMeta, SplitResult, best_gain_per_feature,
                          find_best_split)
+from ..ops.wave_grower import WaveGrowerConfig, make_wave_grower
 
 AXIS = "workers"
 
@@ -57,12 +62,14 @@ def make_mesh(num_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devs[:n]), (AXIS,))
 
 
-def sync_best_split(res: SplitResult) -> SplitResult:
-    """Cross-device argmax of per-device best splits — the analog of
-    SyncUpGlobalBestSplit (parallel_tree_learner.h:183-207)."""
-    gathered = jax.lax.all_gather(res, AXIS)      # pytree of [D, ...]
-    best = jnp.argmax(gathered.gain)
-    return SplitResult(*[leaf[best] for leaf in gathered])
+def sync_best_splits(res: SplitResult) -> SplitResult:
+    """Cross-device argmax of per-device best-split batches — the analog
+    of SyncUpGlobalBestSplit (parallel_tree_learner.h:183-207) over a
+    whole wave of children at once."""
+    gathered = jax.lax.all_gather(res, AXIS)      # pytree of [D, M, ...]
+    best = jnp.argmax(gathered.gain, axis=0)      # [M]
+    m = best.shape[0]
+    return SplitResult(*[leaf[best, jnp.arange(m)] for leaf in gathered])
 
 
 def _slice_meta(meta: FeatureMeta, start, size: int) -> FeatureMeta:
@@ -71,59 +78,69 @@ def _slice_meta(meta: FeatureMeta, start, size: int) -> FeatureMeta:
         for a in meta])
 
 
-def make_data_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
+def _hist(cfg: WaveGrowerConfig):
+    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
+        return wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
+                              num_bins=cfg.num_bins, chunk=cfg.chunk,
+                              use_pallas=cfg.use_pallas)
+    return hist_fn
+
+
+def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                               mesh: Mesh):
-    """Rows sharded over the mesh; histograms psummed.
+    """Rows sharded over the mesh; wave histograms psummed.
 
     (DataParallelTreeLearner semantics; the reference reduce-scatters so
     each worker reduces a feature subset — with XLA the psum IS the
     reduce+broadcast and the compiler picks the wire algorithm.)
     """
-    B = cfg.num_bins
+    local_hist = _hist(cfg)
 
-    def hist_fn(bins, w):
-        local = build_histogram(bins, w, num_bins=B, chunk=cfg.chunk)
-        return jax.lax.psum(local, AXIS)
+    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
+        return jax.lax.psum(
+            local_hist(bins_t, g, h, leaf_ids, wave_leaves), AXIS)
 
     def reduce_fn(x):
         return jax.lax.psum(x, AXIS)
 
-    grow = make_tree_grower(cfg, meta, hist_fn=hist_fn,
+    grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
                             reduce_fn=reduce_fn, jit=False)
     sharded = jax.shard_map(
         grow, mesh=mesh,
-        in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(None)),
+        in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P(None)),
         out_specs=(P(), P(AXIS)),
         check_vma=False)
     return jax.jit(sharded)
 
 
-def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
+def make_feature_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                  mesh: Mesh, num_features: int):
     """Every device holds all rows; feature slice per device for the
     histogram/split work (FeatureParallelTreeLearner semantics)."""
-    B = cfg.num_bins
     D = mesh.devices.size
     if num_features % D != 0:
         raise ValueError("feature-parallel requires padded features")
     Fd = num_features // D
+    local_hist = _hist(cfg)
 
-    def hist_fn(bins, w):
+    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
         i = jax.lax.axis_index(AXIS)
-        local_bins = jax.lax.dynamic_slice_in_dim(bins, i * Fd, Fd, 1)
-        return build_histogram(local_bins, w, num_bins=B, chunk=cfg.chunk)
+        local_bins = jax.lax.dynamic_slice_in_dim(bins_t, i * Fd, Fd, 0)
+        return local_hist(local_bins, g, h, leaf_ids, wave_leaves)
 
-    def split_fn(hist, sg, sh, nd, fmask, can):
+    def split_fn(hists, sg, sh, nd, fmask, can):
         i = jax.lax.axis_index(AXIS)
         meta_l = _slice_meta(meta, i * Fd, Fd)
         fmask_l = jax.lax.dynamic_slice_in_dim(fmask, i * Fd, Fd, 0)
-        res = find_best_split(hist, sg, sh, nd, fmask_l, meta_l,
-                              cfg.hp, can)
+        res = jax.vmap(
+            lambda hh, a, b, c, d: find_best_split(
+                hh, a, b, c, fmask_l, meta_l, cfg.hp, d)
+        )(hists, sg, sh, nd, can)
         res = res._replace(
             feature=jnp.where(res.feature >= 0, res.feature + i * Fd, -1))
-        return sync_best_split(res)
+        return sync_best_splits(res)
 
-    grow = make_tree_grower(cfg, meta, hist_fn=hist_fn, split_fn=split_fn,
+    grow = make_wave_grower(cfg, meta, hist_fn=hist_fn, split_fn=split_fn,
                             jit=False)
     sharded = jax.shard_map(
         grow, mesh=mesh,
@@ -133,14 +150,13 @@ def make_feature_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
     return jax.jit(sharded)
 
 
-def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
+def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                 mesh: Mesh, num_features: int,
                                 top_k: int = 20):
     """Data-parallel with PV-Tree vote compression
     (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp:166-360):
-    local top-k vote -> elect 2k global features -> psum only elected
-    histograms."""
-    B = cfg.num_bins
+    per child, local top-k vote -> elect 2k global features -> psum only
+    elected histograms."""
     D = mesh.devices.size
     k = max(1, min(top_k, num_features))
     k2 = min(2 * k, num_features)
@@ -151,56 +167,69 @@ def make_voting_parallel_grower(cfg: GrowerConfig, meta: FeatureMeta,
         min_data_in_leaf=cfg.hp.min_data_in_leaf / D,
         min_sum_hessian_in_leaf=cfg.hp.min_sum_hessian_in_leaf / D)
 
-    def hist_fn(bins, w):
-        # LOCAL histograms — no psum here; election decides what is summed
-        return build_histogram(bins, w, num_bins=B, chunk=cfg.chunk)
+    # LOCAL histograms — no psum; the election decides what is summed
+    hist_fn = _hist(cfg)
 
     def reduce_fn(x):
         return jax.lax.psum(x, AXIS)
 
-    def split_fn(hist, sg, sh, nd, fmask, can):
-        # 1. local per-feature gains over the LOCAL histogram with
+    def split_fn(hists, sg, sh, nd, fmask, can):
+        # 1. local per-feature gains over the LOCAL histograms with
         #    per-shard totals and gates (the reference votes with local
         #    leaf sumups and num_machines-scaled thresholds,
         #    voting_parallel_tree_learner.cpp:53-55,151-160)
-        local_gain = best_gain_per_feature(hist, sg / D, sh / D, nd / D,
-                                           fmask, meta_dev, hp_vote, can)
-        _, local_top = jax.lax.top_k(local_gain, k)
-        # 2. global vote: one-hot count of each device's top-k
-        votes = jnp.zeros(num_features, jnp.float32).at[local_top].add(1.0)
+        local_gain = jax.vmap(
+            lambda hh, a, b, c, d: best_gain_per_feature(
+                hh, a, b, c, fmask, meta_dev, hp_vote, d)
+        )(hists, sg / D, sh / D, nd / D, can)            # [M, F]
+        _, local_top = jax.lax.top_k(local_gain, k)       # [M, k]
+        # 2. global vote: one-hot count of each device's top-k per child
+        m = local_gain.shape[0]
+        votes = jnp.zeros((m, num_features), jnp.float32)
+        votes = votes.at[jnp.arange(m)[:, None], local_top].add(1.0)
         votes = jax.lax.psum(votes, AXIS)
-        # deterministic tie-break by summed local gain
+        # deterministic tie-break by summed local gain rank
         finite_gain = jnp.where(jnp.isfinite(local_gain), local_gain, 0.0)
         gain_sum = jax.lax.psum(finite_gain, AXIS)
         score = votes + 1e-6 * jax.nn.sigmoid(gain_sum)
-        _, elected = jax.lax.top_k(score, k2)        # [2k] global ids
+        _, elected = jax.lax.top_k(score, k2)             # [M, 2k]
         # 3. aggregate ONLY the elected features' histograms
-        elected_hist = jax.lax.psum(hist[elected], AXIS)   # [2k, B, 3]
-        meta_e = FeatureMeta(*[a[elected] for a in meta_dev])
+        elected_hist = jax.lax.psum(
+            jnp.take_along_axis(
+                hists, elected[:, :, None, None], axis=1), AXIS)
+        meta_e = FeatureMeta(*[a[elected] for a in meta_dev])  # [M, 2k]
         fmask_e = fmask[elected]
-        res = find_best_split(elected_hist, sg, sh, nd, fmask_e, meta_e,
-                              cfg.hp, can)
+        res = jax.vmap(
+            lambda hh, a, b, c, fm, me, d: find_best_split(
+                hh, a, b, c, fm, me, cfg.hp, d),
+            in_axes=(0, 0, 0, 0, 0, 0, 0),
+        )(elected_hist, sg, sh, nd, fmask_e, meta_e, can)
         return res._replace(
-            feature=jnp.where(res.feature >= 0, elected[res.feature], -1))
+            feature=jnp.where(
+                res.feature >= 0,
+                jnp.take_along_axis(
+                    elected, jnp.maximum(res.feature, 0)[:, None],
+                    axis=1)[:, 0],
+                -1))
 
-    grow = make_tree_grower(cfg, meta, hist_fn=hist_fn, split_fn=split_fn,
+    grow = make_wave_grower(cfg, meta, hist_fn=hist_fn, split_fn=split_fn,
                             reduce_fn=reduce_fn, jit=False)
     sharded = jax.shard_map(
         grow, mesh=mesh,
-        in_specs=(P(AXIS, None), P(AXIS), P(AXIS), P(AXIS), P(None)),
+        in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P(None)),
         out_specs=(P(), P(AXIS)),
         check_vma=False)
     return jax.jit(sharded)
 
 
-def make_grower_for_mode(mode: str, cfg: GrowerConfig, meta: FeatureMeta,
-                         mesh: Optional[Mesh], num_features: int,
-                         top_k: int = 20):
+def make_grower_for_mode(mode: str, cfg: WaveGrowerConfig,
+                         meta: FeatureMeta, mesh: Optional[Mesh],
+                         num_features: int, top_k: int = 20):
     """Factory matching TreeLearner::CreateTreeLearner
     (src/treelearner/tree_learner.cpp:9-33) — {serial, feature, data,
     voting} on the tpu device type."""
     if mode == "serial" or mesh is None or mesh.devices.size == 1:
-        return make_tree_grower(cfg, meta)
+        return make_wave_grower(cfg, meta)
     if mode == "data":
         return make_data_parallel_grower(cfg, meta, mesh)
     if mode == "feature":

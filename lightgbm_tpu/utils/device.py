@@ -19,3 +19,10 @@ def get_devices(platform: Optional[str] = None) -> List:
     if plat:
         return jax.local_devices(backend=plat)
     return jax.devices()
+
+
+def on_tpu() -> bool:
+    """True when framework computation actually runs on a TPU device —
+    gates Pallas kernel dispatch (Pallas TPU kernels can't lower for the
+    CPU backend). Honors LGBM_TPU_PLATFORM like get_devices()."""
+    return get_devices()[0].platform == "tpu"
