@@ -274,3 +274,185 @@ def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     return wave_histogram_xla(
         bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
         chunk=chunk or 65536, precision=precision)
+
+
+# ---------------------------------------------------------------------------
+# Fused partition + wave histogram Pallas kernel
+# ---------------------------------------------------------------------------
+
+# rows of the packed per-slot split table (int32 [16, 128])
+TBL_PARENT, TBL_NEW, TBL_FEAT, TBL_BIN, TBL_DLEFT = 0, 1, 2, 3, 4
+TBL_MISS, TBL_DEFBIN, TBL_NUMBIN, TBL_SMALL = 5, 6, 7, 8
+TBL_ROWS = 16           # padded to an int32 sublane multiple
+
+FUSED_MAX_WAVE = 32     # 4 channels x W <= 128 MXU lanes
+
+
+def _fused_kernel(tbl_ref, binsf_ref, binsr_ref, ghm_ref, leaf_ref,
+                  hist_ref, leaf_out_ref, *, F, B, W, groups, group_sz):
+    """One grid step: partition one row chunk by the wave's W splits,
+    then accumulate the wave's smaller-child histograms.
+
+    tbl_ref:   [16, 128] i32 packed split table (TBL_* rows; col k =
+               wave slot k, -1 parent = inactive slot)
+    binsf_ref: [F, Ct]  feature-major bins (one-hot tiles)
+    binsr_ref: [Ct, F]  row-major bins (partition column extraction)
+    ghm_ref:   [Ct, 4]  f32 (grad, hess, bag_mask, 0); grad/hess are
+               pre-masked, the mask rides separately for the counts
+    leaf_ref:  [Ct, 1]  i32 leaf ids BEFORE this wave (all rows,
+               out-of-bag included)
+    hist_ref:  [groups, gb_pad, 128] accumulated histograms
+    leaf_out_ref: [Ct, 1] i32 leaf ids AFTER this wave
+
+    Channel layout (4W <= 128): [g_hi | g_lo | h | count] x W — grad in
+    exact bf16 hi/lo halves (see _wave_hist_kernel), hessian single
+    bf16 (strictly positive, so the 2^-9 rounding is relative-only and
+    cancels nowhere), count exact.
+    """
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        hist_ref[...] = jnp.zeros_like(hist_ref)
+
+    i32 = jnp.int32
+    tbl = tbl_ref[...]
+    leaf = leaf_ref[...]                                # [Ct, 1]
+    ct = leaf.shape[0]
+
+    # ---- partition (DataPartition::Split, data_partition.hpp:109) ----
+    feat_row = tbl[TBL_FEAT:TBL_FEAT + 1, :W]           # [1, W]
+    cols = jnp.zeros((ct, W), i32)
+    for f in range(F):
+        cols = jnp.where(feat_row == f,
+                         binsr_ref[:, f:f + 1].astype(i32), cols)
+    bin_row = tbl[TBL_BIN:TBL_BIN + 1, :W]
+    dleft = tbl[TBL_DLEFT:TBL_DLEFT + 1, :W]
+    miss = tbl[TBL_MISS:TBL_MISS + 1, :W]
+    defb = tbl[TBL_DEFBIN:TBL_DEFBIN + 1, :W]
+    nb = tbl[TBL_NUMBIN:TBL_NUMBIN + 1, :W]
+    parent = tbl[TBL_PARENT:TBL_PARENT + 1, :W]
+    new_id = tbl[TBL_NEW:TBL_NEW + 1, :W]
+    # missing semantics match ops/partition.py row_goes_right
+    is_missing = (((miss == 2) & (cols == nb - 1))
+                  | ((miss == 1) & (cols == defb)))
+    right = jnp.where(is_missing, dleft == 0, cols > bin_row)
+    moved = (leaf == parent) & right & (parent >= 0)    # [Ct, W]
+    any_moved = jnp.any(moved, axis=1, keepdims=True)
+    dest = jnp.sum(jnp.where(moved, new_id, 0), axis=1, keepdims=True)
+    leaf_new = jnp.where(any_moved, dest, leaf)         # [Ct, 1]
+    leaf_out_ref[...] = leaf_new
+
+    # ---- wave weight columns ----
+    ghm = ghm_ref[...]
+    gvec = ghm[:, 0:1]
+    hvec = ghm[:, 1:2]
+    mvec = ghm[:, 2:3]
+    small = tbl[TBL_SMALL:TBL_SMALL + 1, :W]
+    m = ((leaf_new == small) & (small >= 0)).astype(jnp.float32)
+    g_hi = gvec.astype(jnp.bfloat16).astype(jnp.float32)
+    g_lo = gvec - g_hi
+    w_cols = jnp.concatenate(
+        [m * g_hi, m * g_lo, m * hvec, m * mvec], axis=1)   # [Ct, 4W]
+    if 4 * W != 128:
+        w_cols = jnp.pad(w_cols, ((0, 0), (0, 128 - 4 * W)))
+
+    # ---- one-hot tiles + MXU accumulate (see _wave_hist_kernel) ----
+    gb = group_sz * B
+    row_iota = jax.lax.broadcasted_iota(i32, (gb, 1), 0)
+    which_feat = row_iota // B
+    which_bin = row_iota % B
+    for p in range(groups):
+        sel = jnp.full((gb, ct), -1, i32)
+        for s in range(group_sz):
+            f = p * group_sz + s
+            if f < F:
+                row = binsf_ref[f, :].astype(i32)
+                sel = jnp.where(which_feat == s, row[None, :], sel)
+        oh_t = (sel == which_bin).astype(jnp.float32)
+        acc = jax.lax.dot_general(
+            oh_t, w_cols, dimension_numbers=(((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)
+        gb_pad = hist_ref.shape[1]
+        if gb_pad != gb:
+            acc = jnp.pad(acc, ((0, gb_pad - gb), (0, 0)))
+        hist_ref[p, :, :] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk"))
+def fused_partition_histogram_pallas(bins_t, bins_r, g, h, sample_mask,
+                                     leaf_ids, tbl, *, num_bins,
+                                     chunk=2048):
+    """Partition one wave + build its smaller-child histograms in ONE
+    data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]).
+
+    tbl: [16, W] int32 packed split table (TBL_* rows). g/h must be
+    pre-masked by sample_mask; counts use the mask channel.
+    """
+    F, n = bins_t.shape
+    W = int(tbl.shape[1])
+    B = num_bins
+    if W > FUSED_MAX_WAVE:
+        raise NotImplementedError(f"fused wave needs W <= {FUSED_MAX_WAVE}")
+    group_sz = max(1, 128 // B)
+    gb = group_sz * B
+    groups = -(-F // group_sz)
+    gb_pad = _round_up(gb, 128)
+
+    pad = (-n) % chunk
+    if pad:
+        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad)))
+        bins_r = jnp.pad(bins_r, ((0, pad), (0, 0)))
+        g = jnp.pad(g, (0, pad))
+        h = jnp.pad(h, (0, pad))
+        sample_mask = jnp.pad(sample_mask, (0, pad))
+        leaf_ids = jnp.pad(leaf_ids, (0, pad), constant_values=-1)
+    n_pad = n + pad
+
+    ghm = jnp.stack([
+        g.astype(jnp.float32), h.astype(jnp.float32),
+        sample_mask.astype(jnp.float32),
+        jnp.zeros_like(g, jnp.float32)], axis=1)          # [N, 4]
+    leaf2d = leaf_ids.astype(jnp.int32)[:, None]          # [N, 1]
+    tbl16 = jnp.pad(tbl.astype(jnp.int32),
+                    ((0, TBL_ROWS - tbl.shape[0]), (0, 128 - W)),
+                    constant_values=-1)                    # [16, 128]
+
+    kernel = functools.partial(
+        _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz)
+
+    hist, leaf_out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // chunk,),
+        in_specs=[
+            pl.BlockSpec((TBL_ROWS, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((F, chunk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, F), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, 4), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((groups, gb_pad, 128), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((chunk, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((groups, gb_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        ),
+    )(tbl16, bins_t, bins_r, ghm, leaf2d)
+
+    # [groups, gb_pad, 128] -> [F, B, 4W] -> [W, F, B, 3]
+    hist = hist[:, :gb, :4 * W].reshape(groups * group_sz, B, 4 * W)[:F]
+    hist = hist.reshape(F, B, 4, W)
+    hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi + lo
+                      hist[:, :, 2],                   # h
+                      hist[:, :, 3]], axis=2)          # count
+    return leaf_out[:n, 0], hist.transpose(3, 0, 1, 2)

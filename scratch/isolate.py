@@ -1,0 +1,118 @@
+"""Isolate wave-hist kernel cost components: full kernel vs no-onehot
+(constant oh) vs no-matmul (reduce oh) vs DMA-only."""
+import functools
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, F, B, W = 1 << 20, 28, 64, 25
+GS = 128 // B
+GB = GS * B
+GROUPS = -(-F // GS)
+r = np.random.default_rng(0)
+bins_t = jnp.asarray(r.integers(0, B, (F, N), dtype=np.uint8))
+ghl = jnp.asarray(np.stack([
+    r.normal(size=N), r.random(N), r.integers(0, 255, N),
+    np.zeros(N)], axis=1).astype(np.float32))
+wl = jnp.asarray(np.arange(W, dtype=np.float32)[None, :])
+wlp = jnp.pad(wl, ((0, 0), (0, 128 - W)), constant_values=-1.0)
+
+
+def make(mode, chunk):
+    def kernel(wl_ref, bins_ref, ghl_ref, out_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        ghl_t = ghl_ref[...]
+        gvec = ghl_t[:, 0:1]
+        hvec = ghl_t[:, 1:2]
+        lvec = ghl_t[:, 2:3]
+        wlv = wl_ref[0, :]
+        m = ((lvec == wlv[None, :]) & (wlv[None, :] >= 0.0))
+        m = m.astype(jnp.float32)
+        mw = m[:, :W]
+        g_hi = gvec.astype(jnp.bfloat16).astype(jnp.float32)
+        g_lo = gvec - g_hi
+        h_hi = hvec.astype(jnp.bfloat16).astype(jnp.float32)
+        h_lo = hvec - h_hi
+        w_cols = jnp.concatenate(
+            [mw * g_hi, mw * g_lo, mw * h_hi, mw * h_lo, mw], axis=1)
+        w_cols = jnp.pad(w_cols, ((0, 0), (0, 128 - 5 * W)))
+
+        ct = ghl_t.shape[0]
+        row_iota = jax.lax.broadcasted_iota(jnp.int32, (GB, 1), 0)
+        which_feat = row_iota // B
+        which_bin = row_iota % B
+        for p in range(GROUPS):
+            if mode == "noonehot":
+                oh_t = jnp.full((GB, ct), 1.0, jnp.float32)
+            else:
+                sel = jnp.full((GB, ct), -1, jnp.int32)
+                for s in range(GS):
+                    f = p * GS + s
+                    if f < F:
+                        row = bins_ref[f, :].astype(jnp.int32)
+                        sel = jnp.where(which_feat == s, row[None, :], sel)
+                oh_t = (sel == which_bin).astype(jnp.float32)
+            if mode == "nomatmul":
+                acc = jnp.broadcast_to(
+                    jnp.sum(oh_t, axis=1, keepdims=True), (GB, 128))
+            else:
+                acc = jax.lax.dot_general(
+                    oh_t, w_cols,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    precision=jax.lax.Precision.DEFAULT,
+                    preferred_element_type=jnp.float32)
+            if mode == "dmaonly":
+                acc = acc * 0.0 + jnp.sum(ghl_t) + jnp.sum(
+                    bins_ref[0, :].astype(jnp.int32).astype(jnp.float32))
+            out_ref[p, :, :] += acc
+
+    @jax.jit
+    def run(bins_t, ghl):
+        return pl.pallas_call(
+            kernel,
+            grid=(N // chunk,),
+            in_specs=[
+                pl.BlockSpec((1, 128), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((F, chunk), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((chunk, 4), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((GROUPS, 128, 128),
+                                   lambda i: (0, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((GROUPS, 128, 128),
+                                           jnp.float32),
+        )(wlp, bins_t, ghl)
+    return run
+
+
+def timed(f, k1=4, k2=24):
+    def chain(iters):
+        x = ghl
+        o = None
+        for _ in range(iters):
+            o = f(bins_t, x)
+            x = ghl + o[0, 0, 0] * 1e-30
+        float(np.asarray(o[0, 0, 0]))
+    chain(2)
+    t = time.perf_counter(); chain(k1); t1 = time.perf_counter() - t
+    t = time.perf_counter(); chain(k2); t2 = time.perf_counter() - t
+    return (t2 - t1) / (k2 - k1)
+
+
+for chunk in (1024, 2048):
+    for mode in ("full", "noonehot", "nomatmul", "dmaonly"):
+        for trial in range(2):
+            dt = timed(make(mode, chunk))
+            print(f"chunk={chunk} {mode:9s}: {dt*1e3:.3f} ms")

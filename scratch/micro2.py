@@ -1,0 +1,95 @@
+"""Chained microbenchmarks: each iteration depends on the previous
+output so queue overlap / caching can't fake the timing."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+N, F, B = 1_048_576, 28, 64
+r = np.random.default_rng(0)
+bins_np = r.integers(0, B, (N, F), dtype=np.uint8)
+bins = jnp.asarray(bins_np)
+w3 = jnp.asarray(r.normal(size=(N, 3)).astype(np.float32))
+w96 = jnp.asarray(r.normal(size=(N, 96)).astype(np.float32))
+
+
+def chain_time(name, step, w0, iters=20):
+    """step: (bins, w) -> w (same shape). Chained through the loop."""
+    f = jax.jit(step)
+    w = f(bins, w0)
+    jax.block_until_ready(w)
+    t = time.perf_counter()
+    w = w0
+    for _ in range(iters):
+        w = f(bins, w)
+    jax.block_until_ready(w)
+    dt = (time.perf_counter() - t) / iters
+    print(f"{name}: {dt*1e3:.3f} ms")
+    return dt
+
+
+def hist_step(ncol, chunk=16384, dtype=jnp.float32):
+    def step(bins, w):
+        def body(acc, args):
+            b, wc = args
+            oh = jax.nn.one_hot(b, B, dtype=dtype)
+            h = jnp.einsum("cfb,cd->fbd", oh, wc.astype(dtype),
+                           preferred_element_type=jnp.float32)
+            return acc + h, None
+        bins_c = bins.astype(jnp.int32).reshape(-1, chunk, F)
+        w_c = w.reshape(-1, chunk, ncol)
+        init = jnp.zeros((F, B, ncol), jnp.float32)
+        h, _ = jax.lax.scan(body, init, (bins_c, w_c))
+        # fold hist back into w so the next iteration depends on it
+        return w + jnp.sum(h) * 1e-30
+    return step
+
+
+print("devices:", jax.devices())
+chain_time("(a) hist f32 3col   ", hist_step(3), w3)
+chain_time("(b) hist f32 96col  ", hist_step(96), w96)
+chain_time("(f) hist bf16 3col  ", hist_step(3, dtype=jnp.bfloat16), w3)
+chain_time("(f) hist bf16 96col ", hist_step(96, dtype=jnp.bfloat16), w96)
+chain_time("(a8) hist f32 3c c64k", hist_step(3, chunk=65536), w3)
+chain_time("(b8) hist f32 96c c64k", hist_step(96, chunk=65536), w96)
+
+# gather: chain idx -> gathered -> new idx
+idx0 = jnp.asarray(r.integers(0, N, (N // 2,), dtype=np.int32))
+
+
+def gather_step(bins, idx):
+    rows = jnp.take(bins, idx, axis=0)          # [K, F] uint8
+    return (idx + rows[:, 0].astype(jnp.int32)) % N
+
+
+f = jax.jit(gather_step)
+o = f(bins, idx0)
+jax.block_until_ready(o)
+t = time.perf_counter()
+o = idx0
+for _ in range(20):
+    o = f(bins, o)
+jax.block_until_ready(o)
+print(f"(d) row gather N/2  : {(time.perf_counter()-t)/20*1e3:.3f} ms")
+
+# partition pass chained
+leaf0 = jnp.asarray(r.integers(0, 255, (N,), dtype=np.int32))
+col = jnp.asarray(bins_np[:, 0].astype(np.int32))
+
+
+def part_step(bins, leaf_ids):
+    right = col > 31
+    move = (leaf_ids == 7) & right
+    return jnp.where(move, (leaf_ids + 1) % 255, leaf_ids)
+
+
+f = jax.jit(part_step)
+o = f(bins, leaf0)
+jax.block_until_ready(o)
+t = time.perf_counter()
+o = leaf0
+for _ in range(20):
+    o = f(bins, o)
+jax.block_until_ready(o)
+print(f"(c) partition pass  : {(time.perf_counter()-t)/20*1e3:.3f} ms")

@@ -1,0 +1,78 @@
+"""Scaling sanity checks: vary N; real compute must scale with N."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+r = np.random.default_rng(0)
+F, B = 28, 64
+
+
+def chain(f, args, w0, iters):
+    w = f(*args, w0)
+    jax.block_until_ready(w)
+    t = time.perf_counter()
+    w = w0
+    for _ in range(iters):
+        w = f(*args, w)
+    jax.block_until_ready(w)
+    return (time.perf_counter() - t) / iters
+
+
+def hist_step(bins, w):
+    def body(acc, args):
+        b, wc = args
+        oh = jax.nn.one_hot(b, B, dtype=jnp.float32)
+        h = jnp.einsum("cfb,cd->fbd", oh, wc,
+                       preferred_element_type=jnp.float32)
+        return acc + h, None
+    bins_c = bins.astype(jnp.int32).reshape(-1, 16384, F)
+    w_c = w.reshape(-1, 16384, 3)
+    init = jnp.zeros((F, B, 3), jnp.float32)
+    h, _ = jax.lax.scan(body, init, (bins_c, w_c))
+    return w + jnp.sum(h) * 1e-30
+
+
+for NN in (1 << 20, 1 << 22):
+    bins = jnp.asarray(r.integers(0, B, (NN, F), dtype=np.uint8))
+    w3 = jnp.asarray(r.normal(size=(NN, 3)).astype(np.float32))
+    dt = chain(jax.jit(hist_step), (bins,), w3, 20)
+    print(f"hist  N={NN>>20}M: {dt*1e3:.3f} ms")
+
+# plain elementwise pass over the same data for bandwidth reference
+def ew_step(bins, w):
+    s = jnp.sum(bins.astype(jnp.float32), axis=1)
+    return w + (s[:, None] * 1e-30)
+
+
+for NN in (1 << 20, 1 << 22):
+    bins = jnp.asarray(r.integers(0, B, (NN, F), dtype=np.uint8))
+    w3 = jnp.asarray(r.normal(size=(NN, 3)).astype(np.float32))
+    dt = chain(jax.jit(ew_step), (bins,), w3, 20)
+    gbs = (NN * F + NN * 12) / dt / 1e9
+    print(f"ewise N={NN>>20}M: {dt*1e3:.3f} ms  ({gbs:.0f} GB/s)")
+
+# matmul flops reference
+for M in (2048, 4096):
+    a = jnp.asarray(r.normal(size=(M, M)).astype(np.float32))
+    def mm_step(a, w):
+        return jnp.dot(a, w, preferred_element_type=jnp.float32)
+    dt = chain(jax.jit(mm_step), (a,), a, 10)
+    print(f"matmul f32 {M}: {dt*1e3:.3f} ms  ({2*M**3/dt/1e12:.1f} TFLOPS)")
+    b16 = a.astype(jnp.bfloat16)
+    def mm16_step(a, w):
+        return jnp.dot(a, w, preferred_element_type=jnp.bfloat16)
+    dt = chain(jax.jit(mm16_step), (b16,), b16, 10)
+    print(f"matmul bf16 {M}: {dt*1e3:.3f} ms  ({2*M**3/dt/1e12:.1f} TFLOPS)")
+
+# partition with col as ARG (no closure)
+for NN in (1 << 20, 1 << 22):
+    leaf0 = jnp.asarray(r.integers(0, 255, (NN,), dtype=np.int32))
+    col = jnp.asarray(r.integers(0, B, (NN,), dtype=np.int32))
+    def part_step(col, leaf_ids):
+        right = col > 31
+        move = (leaf_ids == 7) & right
+        return jnp.where(move, leaf_ids + 1, leaf_ids)
+    dt = chain(jax.jit(part_step), (col,), leaf0, 20)
+    print(f"part  N={NN>>20}M: {dt*1e3:.3f} ms  ({NN*12/dt/1e9:.0f} GB/s)")

@@ -1,0 +1,99 @@
+"""Real timings on the axon tunnel: block_until_ready does NOT wait for
+remote execution, so sync via a scalar download and difference two chain
+lengths to cancel the fixed transfer latency."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+r = np.random.default_rng(0)
+F, B = 28, 64
+
+
+def chain_sync(f, args, w0, iters):
+    w = f(*args, w0)
+    float(np.asarray(jnp.sum(w)))  # warm + sync
+    t = time.perf_counter()
+    w = w0
+    for _ in range(iters):
+        w = f(*args, w)
+    s = float(np.asarray(jnp.sum(w)))  # download forces completion
+    return time.perf_counter() - t, s
+
+
+def measure(name, f, args, w0, k1=4, k2=24, per_row=None):
+    t1, _ = chain_sync(f, args, w0, k1)
+    t2, _ = chain_sync(f, args, w0, k2)
+    dt = (t2 - t1) / (k2 - k1)
+    extra = ""
+    if per_row:
+        extra = f"  ({per_row / dt / 1e9:.0f} GB/s-equiv)"
+    print(f"{name}: {dt*1e3:.3f} ms{extra}  [fixed={t1 - k1*dt:.3f}s]")
+    return dt
+
+
+def hist_step_maker(ncol, dtype=jnp.float32, chunk=16384):
+    def hist_step(bins, w):
+        def body(acc, args):
+            b, wc = args
+            oh = jax.nn.one_hot(b, B, dtype=dtype)
+            h = jnp.einsum("cfb,cd->fbd", oh, wc.astype(dtype),
+                           preferred_element_type=jnp.float32)
+            return acc + h, None
+        bins_c = bins.astype(jnp.int32).reshape(-1, chunk, F)
+        w_c = w.reshape(-1, chunk, ncol)
+        init = jnp.zeros((F, B, ncol), jnp.float32)
+        h, _ = jax.lax.scan(body, init, (bins_c, w_c))
+        return w + jnp.sum(h) * 1e-30
+    return hist_step
+
+
+NN = 1 << 20
+bins = jnp.asarray(r.integers(0, B, (NN, F), dtype=np.uint8))
+w3 = jnp.asarray(r.normal(size=(NN, 3)).astype(np.float32))
+w96 = jnp.asarray(r.normal(size=(NN, 96)).astype(np.float32))
+
+measure("hist f32  3col 1M", jax.jit(hist_step_maker(3)), (bins,), w3,
+        per_row=NN * F)
+measure("hist f32 96col 1M", jax.jit(hist_step_maker(96)), (bins,), w96,
+        per_row=NN * F)
+measure("hist bf16 3col 1M", jax.jit(hist_step_maker(3, jnp.bfloat16)),
+        (bins,), w3, per_row=NN * F)
+measure("hist bf16 96col 1M", jax.jit(hist_step_maker(96, jnp.bfloat16)),
+        (bins,), w96, per_row=NN * F)
+
+M = 4096
+a32 = jnp.asarray(r.normal(size=(M, M)).astype(np.float32))
+a16 = a32.astype(jnp.bfloat16)
+dt = measure("matmul f32 4096", jax.jit(
+    lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.float32)),
+    (a32,), a32)
+print(f"   -> {2*M**3/dt/1e12:.1f} TFLOPS")
+dt = measure("matmul bf16 4096", jax.jit(
+    lambda a, w: jnp.dot(a, w, preferred_element_type=jnp.bfloat16)),
+    (a16,), a16)
+print(f"   -> {2*M**3/dt/1e12:.1f} TFLOPS")
+
+leaf0 = jnp.asarray(r.integers(0, 255, (NN,), dtype=np.int32))
+col = jnp.asarray(r.integers(0, B, (NN,), dtype=np.int32))
+
+
+def part_step(col, leaf_ids):
+    right = col > 31
+    move = (leaf_ids == 7) & right
+    return jnp.where(move, leaf_ids + 1, leaf_ids)
+
+
+measure("partition 1M", jax.jit(part_step), (col,), leaf0, per_row=NN * 12)
+
+idx0 = jnp.asarray(r.integers(0, NN, (NN // 2,), dtype=np.int32))
+
+
+def gather_step(bins, idx):
+    rows = jnp.take(bins, idx, axis=0)
+    return (idx + rows[:, 0].astype(jnp.int32)) % NN
+
+
+measure("row-gather N/2 1M", jax.jit(gather_step), (bins,), idx0,
+        per_row=NN // 2 * F)

@@ -1,0 +1,102 @@
+"""Correctness + perf of wave histogram impls. Run on TPU (default env)."""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+from lightgbm_tpu.ops.hist_wave import (wave_histogram_pallas,
+                                        wave_histogram_xla)
+
+r = np.random.default_rng(0)
+
+
+def ref_numpy(bins, g, h, leaf, wl, B):
+    W = len(wl)
+    F = bins.shape[1]
+    out = np.zeros((W, F, B, 3), np.float32)
+    for k, l in enumerate(wl):
+        if l < 0:
+            continue
+        m = leaf == l
+        for f in range(F):
+            bc = np.bincount(bins[m, f], minlength=B)
+            out[k, f, :, 2] = bc[:B]
+            out[k, f, :, 0] = np.bincount(bins[m, f], weights=g[m],
+                                          minlength=B)[:B]
+            out[k, f, :, 1] = np.bincount(bins[m, f], weights=h[m],
+                                          minlength=B)[:B]
+    return out
+
+
+def check(N, F, B, W, chunk, interpret):
+    bins = r.integers(0, B, (N, F), dtype=np.uint8)
+    g = r.normal(size=N).astype(np.float32)
+    h = r.random(N).astype(np.float32)
+    leaf = r.integers(-1, 8, N).astype(np.int32)
+    wl = np.array([0, 3, -1, 7, 5][:W] + [2] * max(0, W - 5), np.int32)
+
+    want = ref_numpy(bins, g, h, leaf, wl, B)
+    bt = jnp.asarray(bins.T.copy())
+    got_x = np.asarray(wave_histogram_xla(
+        bt, jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(leaf), jnp.asarray(wl), num_bins=B, chunk=512))
+    err_x = np.abs(got_x - want).max()
+    got_p = np.asarray(wave_histogram_pallas(
+        bt, jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(leaf), jnp.asarray(wl), num_bins=B, chunk=chunk,
+        interpret=interpret))
+    err_p = np.abs(got_p - want).max()
+    print(f"N={N} F={F} B={B} W={W}: xla_err={err_x:.2e} "
+          f"pallas_err={err_p:.2e}")
+    assert err_x < 1e-3 and err_p < 1e-3
+
+
+interp = jax.default_backend() != "tpu"
+print("backend:", jax.default_backend(), "interpret:", interp)
+check(1000, 7, 16, 5, 256, interp)
+check(2048, 28, 63, 25, 512, interp)
+check(513, 3, 255, 1, 256, interp)
+check(4096, 12, 64, 25, 1024, interp)
+
+if jax.default_backend() == "tpu":
+    # perf at HIGGS-class size
+    N, F, B = 1 << 20, 28, 64
+    bins = jnp.asarray(r.integers(0, B, (F, N), dtype=np.uint8))
+    g = jnp.asarray(r.normal(size=N).astype(np.float32))
+    h = jnp.asarray(r.random(N).astype(np.float32))
+    leaf = jnp.asarray(r.integers(0, 255, N).astype(np.int32))
+
+    def run_chain(f, W, chunk, iters):
+        wl = jnp.arange(W, dtype=jnp.int32)
+        gg = g
+        o = None
+        for i in range(iters):
+            o = f(bins, gg, h, leaf, wl, num_bins=B, chunk=chunk)
+            gg = g + o[0, 0, 0, 0] * 1e-30
+        float(np.asarray(o[0, 0, 0, 0]))
+
+    def timed(f, W, chunk, k1=4, k2=24):
+        run_chain(f, W, chunk, 2)   # warm/compile
+        t = time.perf_counter(); run_chain(f, W, chunk, k1)
+        t1 = time.perf_counter() - t
+        t = time.perf_counter(); run_chain(f, W, chunk, k2)
+        t2 = time.perf_counter() - t
+        return (t2 - t1) / (k2 - k1)
+
+    import functools as ft
+    for prec in ("highest", "default"):
+        for W in ((1, 25, 42) if prec == 'default' else (1, 16, 25)):
+            for chunk in (1024, 2048, 4096):
+                try:
+                    f = ft.partial(wave_histogram_pallas, precision=prec)
+                    dt = timed(f, W, chunk)
+                    print(f"pallas {prec[:4]} W={W:2d} chunk={chunk}: {dt*1e3:.3f} ms")
+                except Exception as e:
+                    print(f"pallas {prec[:4]} W={W:2d} chunk={chunk}: FAIL "
+                          f"{str(e).splitlines()[0][:90]}")
+    for W in (1, 32):
+        dt = timed(wave_histogram_xla, W, 65536)
+        print(f"xla    W={W:2d}: {dt*1e3:.3f} ms")
