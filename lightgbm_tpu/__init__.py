@@ -1,0 +1,27 @@
+"""lightgbm_tpu: a TPU-native gradient boosting framework.
+
+Public surface mirrors the reference python package
+(reference: python-package/lightgbm/__init__.py): Dataset/Booster,
+train/cv, callbacks, and sklearn-style estimators — backed by a
+JAX/XLA/Pallas engine instead of the C++ core.
+"""
+from .basic import Booster, Dataset
+from .callback import (EarlyStopException, early_stopping,
+                       print_evaluation, record_evaluation,
+                       reset_parameter)
+from .engine import CVBooster, cv, train
+from .utils.log import LightGBMError
+
+try:
+    from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+    _SKLEARN_EXPORTS = ["LGBMModel", "LGBMRegressor", "LGBMClassifier",
+                        "LGBMRanker"]
+except ImportError:          # scikit-learn not installed
+    _SKLEARN_EXPORTS = []
+
+__version__ = "0.3.0"
+
+__all__ = ["Dataset", "Booster", "train", "cv", "CVBooster",
+           "LightGBMError", "EarlyStopException", "print_evaluation",
+           "record_evaluation", "reset_parameter",
+           "early_stopping"] + _SKLEARN_EXPORTS

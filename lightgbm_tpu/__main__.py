@@ -1,0 +1,8 @@
+"""CLI entry point: ``python -m lightgbm_tpu config=train.conf [k=v ...]``.
+
+Counterpart of the reference executable main (reference: src/main.cpp).
+"""
+from .application import main
+
+if __name__ == "__main__":
+    main()

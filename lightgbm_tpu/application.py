@@ -1,0 +1,188 @@
+"""Command-line application: train / predict.
+
+TPU-native counterpart of the reference Application
+(reference: src/application/application.cpp:29-281 and src/main.cpp).
+Parameters come from ``key=value`` argv tokens plus an optional
+``config=<file>`` of ``key = value`` lines (comments with '#'), exactly
+like Application::LoadParameters (application.cpp:64-108). Tasks:
+
+- train (+ refit): load data + valids, build objective/metrics, run the
+  GBDT::Train driver (models/gbdt.py:train), save output_model
+- predict: load input_model, parse the data file, write output_result
+- convert_model: emit the model as standalone if-else C++ code
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .config import Config
+from .io.loader import DatasetLoader
+from .metrics import create_metrics
+from .models.gbdt import GBDT
+from .objectives import create_objective
+from .utils import log
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """Config-file 'key = value' lines (application.cpp:76-99)."""
+    out: Dict[str, str] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out.setdefault(k.strip(), v.strip())
+    return out
+
+
+def load_parameters(argv: List[str]) -> Config:
+    """argv 'k=v' tokens override config-file values
+    (application.cpp:64-108: cmd wins over file)."""
+    cmd: Dict[str, str] = {}
+    for tok in argv:
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            cmd[Config.key_alias_transform(k)] = v.strip()
+        elif tok:
+            log.warning("Unknown parameter %s", tok)
+    params = dict(cmd)
+    if "config" in cmd:
+        for k, v in parse_config_file(cmd["config"]).items():
+            params.setdefault(Config.key_alias_transform(k), v)
+    cfg = Config()
+    cfg.set(params)
+    # -1 = fatal-only, 0 = warnings, 1 = info, 2+ = debug (log.h:22)
+    log.set_level(max(-1, min(cfg.verbosity, 2)))
+    return cfg
+
+
+def _rel_to_config(cfg: Config, path: str) -> str:
+    """Data paths in a config file resolve relative to that file
+    (matching how the reference examples are invoked from their dir)."""
+    if path and not os.path.isabs(path) and not os.path.exists(path) \
+            and cfg.config:
+        cand = os.path.join(os.path.dirname(os.path.abspath(cfg.config)),
+                            path)
+        if os.path.exists(cand):
+            return cand
+    return path
+
+
+class Application:
+    """Application (application.cpp:29-63)."""
+
+    def __init__(self, argv: List[str]):
+        self.config = load_parameters(argv)
+        if self.config.task in ("train", "refit") and not self.config.data:
+            log.fatal("No training/prediction data, application quit")
+
+    def run(self) -> None:
+        task = self.config.task
+        if task == "train":
+            self.train()
+        elif task == "predict":
+            self.predict()
+        elif task == "convert_model":
+            self.convert_model()
+        elif task == "refit":
+            log.fatal("Task refit is not supported yet")
+        else:
+            log.fatal(f"Unknown task: {task}")
+
+    # -- train (application.cpp:110-232 LoadData + Train) -------------------
+
+    def train(self) -> None:
+        cfg = self.config
+        loader = DatasetLoader(cfg)
+        train_path = _rel_to_config(cfg, cfg.data)
+        train_data = loader.load_from_file(train_path)
+
+        objective = create_objective(cfg.objective, cfg)
+        if objective is not None:
+            objective.init(train_data.metadata, train_data.num_data)
+        from .basic import _resolve_metric_names
+        metric_names = _resolve_metric_names(cfg)
+        train_metrics = []
+        if cfg.is_provide_training_metric:
+            train_metrics = create_metrics(
+                metric_names, cfg, train_data.metadata, train_data.num_data)
+
+        booster = GBDT()
+        if cfg.input_model:
+            with open(_rel_to_config(cfg, cfg.input_model)) as fh:
+                booster.load_model_from_string(fh.read())
+            booster.init_from_loaded(cfg, train_data, objective,
+                                     train_metrics)
+        else:
+            booster.init(cfg, train_data, objective, train_metrics)
+
+        for i, vpath in enumerate(cfg.valid):
+            vdata = loader.load_from_file(_rel_to_config(cfg, vpath),
+                                          reference=train_data)
+            vmetrics = create_metrics(metric_names, cfg, vdata.metadata,
+                                      vdata.num_data)
+            booster.add_valid_data(vdata, vmetrics,
+                                   os.path.basename(vpath))
+        booster.train(cfg.snapshot_freq, cfg.output_model)
+
+    # -- predict (application.cpp:234-249) ----------------------------------
+
+    def predict(self) -> None:
+        cfg = self.config
+        model_path = _rel_to_config(cfg, cfg.input_model)
+        if not model_path or not os.path.isfile(model_path):
+            log.fatal(f"Model file {cfg.input_model!r} not found; set "
+                      "input_model for the predict task")
+        booster = GBDT()
+        with open(model_path) as fh:
+            booster.load_model_from_string(fh.read())
+        loader = DatasetLoader(cfg)
+        data_path = _rel_to_config(cfg, cfg.data)
+        X, _ = loader.load_predict_matrix(data_path,
+                                          booster.max_feature_idx + 1)
+        n_iter = cfg.num_iteration_predict
+        if cfg.predict_leaf_index:
+            out = booster.predict_leaf_index(X, n_iter)
+        elif cfg.predict_contrib:
+            out = booster.predict_contrib(X, n_iter)
+        elif cfg.predict_raw_score:
+            out = booster.predict_raw(X, n_iter)
+        else:
+            out = booster.predict(X, n_iter)
+        out = np.asarray(out)
+        out_path = cfg.output_result or "LightGBM_predict_result.txt"
+        with open(out_path, "w") as fh:
+            if out.ndim == 1:
+                for v in out:
+                    fh.write(f"{v:g}\n")
+            else:
+                for row in out:
+                    fh.write("\t".join(f"{v:g}" for v in row) + "\n")
+        log.info("Finished prediction; results saved to %s", out_path)
+
+    # -- convert_model (if-else codegen) -------------------------------------
+
+    def convert_model(self) -> None:
+        cfg = self.config
+        model_path = _rel_to_config(cfg, cfg.input_model)
+        if not model_path or not os.path.isfile(model_path):
+            log.fatal("convert_model requires input_model")
+        from .models.codegen import model_to_if_else
+        booster = GBDT()
+        with open(model_path) as fh:
+            booster.load_model_from_string(fh.read())
+        code = model_to_if_else(booster)
+        with open(cfg.convert_model, "w") as fh:
+            fh.write(code)
+        log.info("Converted model saved to %s", cfg.convert_model)
+
+
+def main(argv: List[str] = None) -> None:
+    if argv is None:
+        argv = sys.argv[1:]
+    Application(argv).run()

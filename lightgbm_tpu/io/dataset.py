@@ -168,6 +168,16 @@ class TpuDataset:
         return len(self.mappers)
 
     def feature_meta(self) -> FeatureMeta:
+        if not self.mappers:
+            # all features trivial: one dummy single-bin feature matching
+            # the [N, 1] zero bin matrix — never splittable, so the tree
+            # stays the constant prior (gbdt.cpp:378-396)
+            return FeatureMeta(
+                num_bin=np.ones(1, np.int32),
+                missing_type=np.zeros(1, np.int32),
+                default_bin=np.zeros(1, np.int32),
+                monotone=np.zeros(1, np.int32),
+                penalty=np.ones(1, np.float32))
         mono = None
         if self.config.monotone_constraints:
             mono = [0] * self.num_features

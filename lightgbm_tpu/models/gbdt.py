@@ -121,6 +121,12 @@ class GBDT:
         # number of leading iteration-groups already verified productive,
         # so each periodic stop check scans only the new tail
         self._clean_groups = 0
+        # fused-step state (see _get_step_fn)
+        self._step_key = None
+        self._zero_bias = jnp.zeros(self.num_tree_per_iteration,
+                                    jnp.float32)
+        self._dummy_gh = jnp.zeros((1, 1), jnp.float32)
+        self._fmask_cache = None
 
     def _setup_grower(self):
         cfg = self.config
@@ -178,7 +184,9 @@ class GBDT:
         W = max(1, min(W, max(cfg.num_leaves, 2) - 1))
         gcfg = WaveGrowerConfig(
             num_leaves=max(cfg.num_leaves, 2),
-            num_bins=self.train_data.max_bin_global,
+            # >= 2 so the per-feature split scan is never empty (the
+            # all-trivial-features case has one dummy single-bin feature)
+            num_bins=max(self.train_data.max_bin_global, 2),
             wave_size=W,
             max_depth=cfg.max_depth,
             chunk=0,
@@ -186,6 +194,7 @@ class GBDT:
         self._grower_cfg = gcfg
         self._grower = make_grower_for_mode(
             mode, gcfg, meta, mesh, self._f_pad, cfg.top_k)
+        self._step_key = None       # grower changed: rebuild fused step
 
     def _init_scores(self):
         n, k = self._n, self.num_tree_per_iteration
@@ -219,6 +228,43 @@ class GBDT:
                 add_leaf_outputs(self._valid_scores[-1][cls], leaf,
                                  rec.leaf_output, 1.0))
 
+    def init_from_loaded(self, config: Config, train_data: TpuDataset,
+                         objective: Optional[ObjectiveFunction],
+                         training_metrics: Sequence[Metric] = ()):
+        """Continued training (input_model): call after
+        ``load_model_from_string``. Rebuilds device TreeRecords for the
+        loaded host trees (bin-space thresholds via the new mappers) and
+        replays them into the train scores, so training continues exactly
+        where the loaded model stopped (boosting.cpp:30-55 +
+        gbdt.cpp ResetTrainingData semantics)."""
+        from ..ops.grower import TreeRecord
+        loaded_models = [m for m in self.models if m is not None]
+        if len(loaded_models) != len(self.models):
+            log.fatal("init_from_loaded requires a fully loaded model")
+        k_loaded = max(self.num_tree_per_iteration, 1)
+        self.init(config, train_data, objective, training_metrics)
+        if self.num_tree_per_iteration != k_loaded:
+            log.fatal("num_class of input_model doesn't match config")
+        L = self._grower_cfg.num_leaves
+        from .tree import record_arrays_from_tree
+        self.models = loaded_models
+        self.records = []
+        self._tree_shrinkage = [m.shrinkage if m.shrinkage else 1.0
+                                for m in loaded_models]
+        for t_idx, tree in enumerate(loaded_models):
+            arrs = record_arrays_from_tree(
+                tree, train_data.real_to_inner, train_data.mappers, L)
+            rec = TreeRecord(**{k: jnp.asarray(v)
+                                for k, v in arrs.items()})
+            self.records.append(rec)
+            cls = t_idx % self.num_tree_per_iteration
+            leaf = replay_partition(rec, self._bins_dev, self._meta)
+            self._scores = self._scores.at[cls].set(add_leaf_outputs(
+                self._scores[cls], leaf[:self._n], rec.leaf_output, 1.0))
+        self.iter_ = len(loaded_models) // self.num_tree_per_iteration
+        self._clean_groups = self.iter_
+        log.info("Continuing training from iteration %d", self.iter_)
+
     # -- bagging (gbdt.cpp:161-243) -----------------------------------------
 
     def _bagging_mask(self, iteration: int) -> Optional[np.ndarray]:
@@ -237,7 +283,8 @@ class GBDT:
 
     def _feature_mask(self) -> np.ndarray:
         cfg = self.config
-        f = self.train_data.num_features
+        # >= 1: the all-trivial-features case has one dummy feature
+        f = max(self.train_data.num_features, 1)
         mask = np.ones(f, bool)
         if cfg.feature_fraction < 1.0:
             used = max(1, int(f * cfg.feature_fraction))
@@ -245,6 +292,22 @@ class GBDT:
             mask = np.zeros(f, bool)
             mask[sel] = True
         return mask
+
+    def _feature_mask_dev(self) -> jax.Array:
+        """Padded device feature mask; the all-features case is cached so
+        the common path uploads nothing per iteration."""
+        if self.config.feature_fraction >= 1.0:
+            if self._fmask_cache is None:
+                m = np.ones(max(self.train_data.num_features, 1), bool)
+                if self._pad_features:
+                    m = np.concatenate(
+                        [m, np.zeros(self._pad_features, bool)])
+                self._fmask_cache = jnp.asarray(m)
+            return self._fmask_cache
+        m = self._feature_mask()
+        if self._pad_features:
+            m = np.concatenate([m, np.zeros(self._pad_features, bool)])
+        return jnp.asarray(m)
 
     # -- boosting (gbdt.cpp:333-412) ----------------------------------------
 
@@ -270,6 +333,102 @@ class GBDT:
             return init
         return 0.0
 
+    def _get_step_fn(self, custom: bool):
+        """ONE jitted function for a full boosting iteration.
+
+        Everything — gradients, K tree builds, renew, shrinkage fold,
+        AddBias on the stored record, train+valid score updates — runs
+        as a single XLA program. This is the TPU-critical design point:
+        eager op dispatch is a high-latency host<->device RPC on this
+        platform (measured ~24 ms per op on the tunneled backend), and
+        an un-fused iteration pays ~100 of them. Fused: one dispatch.
+        Retraces only when a valid set is added or the custom-gradient
+        mode flips; shrinkage/init-bias are traced arguments.
+        """
+        key = (custom, len(self._valid_bins_dev))
+        if getattr(self, "_step_key", None) == key:
+            return self._step_fn
+        obj = self.objective
+        grower = self._grower
+        K = self.num_tree_per_iteration
+        n, pad_rows = self._n, self._pad_rows
+        bins = self._bins_dev
+        valid_bins = tuple(self._valid_bins_dev)
+        meta = self._meta
+        L = self._grower_cfg.num_leaves
+        renew = (not custom) and obj is not None \
+            and obj.is_renew_tree_output()
+        if renew:
+            from ..ops.renew import renew_leaf_outputs
+            renew_label = jnp.asarray(
+                obj.trans_label if hasattr(obj, "trans_label")
+                else obj.label, jnp.float32)
+            w = getattr(obj, "label_weight", None)
+            if w is None:
+                w = obj.weights
+            renew_w = None if w is None else jnp.asarray(w, jnp.float32)
+            renew_alpha = float(obj.renew_tree_output_percentile())
+
+        def step(scores, valid_scores, mask, fmask, shrink, init_bias,
+                 g_in, h_in):
+            if custom:
+                g_all, h_all = g_in, h_in
+            else:
+                g_all, h_all = obj.get_gradients(
+                    scores if K > 1 else scores[0])
+                if K == 1:
+                    g_all, h_all = g_all[None, :], h_all[None, :]
+            recs = []
+            vs = list(valid_scores)
+            for k in range(K):
+                g_k, h_k = g_all[k], h_all[k]
+                if pad_rows:
+                    zpad = jnp.zeros(pad_rows, jnp.float32)
+                    g_k = jnp.concatenate([g_k, zpad])
+                    h_k = jnp.concatenate([h_k, zpad])
+                rec, leaf_ids = grower(bins, g_k, h_k, mask, fmask)
+                leaf_ids = leaf_ids[:n]
+                if renew:
+                    # objective-driven leaf refit
+                    # (serial_tree_learner.cpp:780-818) against the
+                    # PRE-update scores; splitless trees stay all-zero
+                    # (the reference never renews a tree it is about to
+                    # discard, gbdt.cpp:393-409)
+                    residual = renew_label - scores[k]
+                    new_out = renew_leaf_outputs(
+                        leaf_ids, residual, renew_w, L, renew_alpha,
+                        rec.leaf_output, mask[:n])
+                    new_out = jnp.where(rec.num_leaves > 1, new_out,
+                                        rec.leaf_output)
+                    rec = rec._replace(leaf_output=new_out)
+                # fold shrinkage (Tree::Shrinkage, gbdt.cpp:371)
+                rec = rec._replace(
+                    leaf_output=rec.leaf_output * shrink,
+                    internal_value=rec.internal_value * shrink)
+                # out-of-bag rows included: the partition covers ALL rows
+                scores = scores.at[k].set(add_leaf_outputs(
+                    scores[k], leaf_ids, rec.leaf_output, 1.0))
+                for vi, vb in enumerate(valid_bins):
+                    vleaf = replay_partition(rec, vb, meta)
+                    vs[vi] = vs[vi].at[k].set(add_leaf_outputs(
+                        vs[vi][k], vleaf, rec.leaf_output, 1.0))
+                # AddBias on the STORED record only (tree.h:151): the
+                # init score already reached train/valid scores through
+                # BoostFromAverage's AddScore, so the score updates above
+                # use the un-biased outputs. For a splitless first tree
+                # this also yields the reference's constant tree
+                # (leaf0 = init, gbdt.cpp:378-396); biasing unused leaf
+                # slots is harmless (leaf_ids never reference them).
+                rec = rec._replace(
+                    leaf_output=rec.leaf_output + init_bias[k],
+                    internal_value=rec.internal_value + init_bias[k])
+                recs.append(rec)
+            return scores, tuple(vs), recs
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+        self._step_key = key
+        return self._step_fn
+
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration; returns True if training should stop
@@ -280,24 +439,23 @@ class GBDT:
         boost-from-average bias, exactly like the reference's
         ``Shrinkage`` + ``AddBias`` on the saved tree (gbdt.cpp:371-377).
 
-        Entirely device-resident: no device→host transfer happens here.
-        The "no more splits" stop is detected by a periodic host check
-        (every ``tpu_stop_check_interval`` iterations).
+        Entirely device-resident: ONE fused jit call per iteration, no
+        device->host transfer. The "no more splits" stop is detected by
+        a periodic host check (every ``tpu_stop_check_interval``
+        iterations).
         """
         K = self.num_tree_per_iteration
         init_scores = [0.0] * K
-        if grad is None or hess is None:
+        custom = grad is not None and hess is not None
+        if not custom:
             if self.objective is None:
                 log.fatal("No objective; pass custom grad/hess")
             for k in range(K):
                 init_scores[k] = self.boost_from_average(k)
-            g_all, h_all = self.objective.get_gradients(
-                self._scores if K > 1 else self._scores[0])
-            if K == 1:
-                g_all, h_all = g_all[None, :], h_all[None, :]
+            g_in = h_in = self._dummy_gh
         else:
-            g_all = jnp.asarray(grad, jnp.float32).reshape(K, self._n)
-            h_all = jnp.asarray(hess, jnp.float32).reshape(K, self._n)
+            g_in = jnp.asarray(grad, jnp.float32).reshape(K, self._n)
+            h_in = jnp.asarray(hess, jnp.float32).reshape(K, self._n)
 
         mask_np = self._bagging_mask(self.iter_)
         if mask_np is None:
@@ -307,48 +465,19 @@ class GBDT:
                 mask_np = np.concatenate(
                     [mask_np, np.zeros(self._pad_rows, np.float32)])
             mask = jnp.asarray(mask_np)
-        fmask_np = self._feature_mask()
-        if self._pad_features:
-            fmask_np = np.concatenate(
-                [fmask_np, np.zeros(self._pad_features, bool)])
-        fmask = jnp.asarray(fmask_np)
+        fmask = self._feature_mask_dev()
 
         first_iteration = not self.models
-        for k in range(K):
-            g_k, h_k = g_all[k], h_all[k]
-            if self._pad_rows:
-                g_k = jnp.concatenate(
-                    [g_k, jnp.zeros(self._pad_rows, jnp.float32)])
-                h_k = jnp.concatenate(
-                    [h_k, jnp.zeros(self._pad_rows, jnp.float32)])
-            rec, leaf_ids = self._grower(self._bins_dev, g_k, h_k,
-                                         mask, fmask)
-            leaf_ids = leaf_ids[:self._n]
-            rec = self._renew_tree_output(rec, k, leaf_ids,
-                                          mask[:self._n])
-            # fold shrinkage into outputs (Tree::Shrinkage, gbdt.cpp:371)
-            rec = rec._replace(
-                leaf_output=rec.leaf_output * self.shrinkage_rate,
-                internal_value=rec.internal_value * self.shrinkage_rate)
-            # out-of-bag rows included: the partition covers ALL rows.
-            self._scores = self._scores.at[k].set(add_leaf_outputs(
-                self._scores[k], leaf_ids, rec.leaf_output, 1.0))
-            for vi in range(len(self.valid_sets)):
-                vb = self._valid_bins_dev[vi]
-                vleaf = replay_partition(rec, vb, self._meta)
-                self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
-                    add_leaf_outputs(self._valid_scores[vi][k], vleaf,
-                                     rec.leaf_output, 1.0))
+        init_bias = (jnp.asarray(init_scores, jnp.float32)
+                     if first_iteration else self._zero_bias)
+        step = self._get_step_fn(custom)
+        self._scores, new_valids, recs = step(
+            self._scores, tuple(self._valid_scores), mask, fmask,
+            jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in)
+        self._valid_scores = list(new_valids)
+        for k, rec in enumerate(recs):
             shrinkage_for_file = self.shrinkage_rate
             if first_iteration and abs(init_scores[k]) > 1e-15:
-                # AddBias folds the init into the saved model (tree.h:151).
-                # For a splitless tree this also yields the reference's
-                # constant tree (leaf0 = init, gbdt.cpp:378-396); adding
-                # the bias to unused leaf slots is harmless (leaf_ids
-                # never reference them).
-                rec = rec._replace(
-                    leaf_output=rec.leaf_output + init_scores[k],
-                    internal_value=rec.internal_value + init_scores[k])
                 shrinkage_for_file = 1.0
             self.records.append(rec)
             self.models.append(None)
@@ -358,6 +487,7 @@ class GBDT:
         if self.iter_ % self._stop_check_interval == 0:
             return self._check_stop()
         return False
+
 
     def _num_leaves_host(self, records) -> np.ndarray:
         """Download num_leaves for a list of records in ONE transfer."""
@@ -458,30 +588,6 @@ class GBDT:
             tree.shrinkage = self._tree_shrinkage[i]
             self.models[i] = tree
 
-    def _renew_tree_output(self, rec, class_id, leaf_ids, sample_mask):
-        """Objective-driven leaf refit (serial_tree_learner.cpp:780-818):
-        L1/quantile/MAPE replace leaf outputs with residual percentiles.
-        Runs on device (renew_leaf_outputs) — no host transfer."""
-        obj = self.objective
-        if obj is None or not obj.is_renew_tree_output():
-            return rec
-        from ..ops.renew import renew_leaf_outputs
-        alpha = obj.renew_tree_output_percentile()
-        label = (obj.trans_label if hasattr(obj, "trans_label")
-                 else obj.label)
-        residual = jnp.asarray(label, jnp.float32) - self._scores[class_id]
-        w = getattr(obj, "label_weight", None)
-        if w is None:
-            w = obj.weights
-        w_dev = (None if w is None else jnp.asarray(w, jnp.float32))
-        new_out = renew_leaf_outputs(
-            leaf_ids, residual, w_dev, self._grower_cfg.num_leaves,
-            float(alpha), rec.leaf_output, sample_mask)
-        # splitless trees must stay all-zero (the reference never renews
-        # a tree it is about to discard, gbdt.cpp:393-409)
-        new_out = jnp.where(rec.num_leaves > 1, new_out, rec.leaf_output)
-        return rec._replace(leaf_output=new_out)
-
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:414-430). Training may resume
         afterwards, so the stop latch is cleared."""
@@ -581,6 +687,112 @@ class GBDT:
         for t in range(ntree):
             out[:, t] = self.models[t].predict_leaf_index(X)
         return out
+
+    def predict_contrib(self, X: np.ndarray,
+                        num_iteration: int = -1) -> np.ndarray:
+        """SHAP feature contributions [N, F+1] (or [N, K*(F+1)] for
+        multiclass): per-feature Shapley values + bias column
+        (gbdt.h PredictContrib / tree.h:118)."""
+        self._ensure_host_trees()
+        X = np.asarray(X, np.float64)
+        n = X.shape[0]
+        k = self.num_tree_per_iteration
+        f1 = self.max_feature_idx + 2
+        ntree = self._effective_num_models()
+        if num_iteration >= 0:
+            ntree = min(ntree, num_iteration * k)
+        out = np.zeros((k, n, f1), np.float64)
+        for t_idx in range(ntree):
+            self.models[t_idx].predict_contrib(X, out[t_idx % k])
+        if self.average_output:
+            out /= max(ntree // k, 1)
+        if k == 1:
+            return out[0]
+        return out.transpose(1, 0, 2).reshape(n, k * f1)
+
+    # -- CLI training driver (gbdt.cpp:245-263 GBDT::Train) ------------------
+
+    def train(self, snapshot_freq: int = -1, output_model: str = "") -> None:
+        """The application-side training loop: boosting iterations with
+        per-iteration metric output (OutputMetric, gbdt.cpp:466-534),
+        reference-style early stopping (EvalAndCheckEarlyStopping,
+        gbdt.cpp:432-448: pop the last ``early_stopping_round``
+        iterations on stop), and periodic snapshots."""
+        import time
+        cfg = self.config
+        # best_score_[i][j] per (valid set, metric), in
+        # bigger-is-better orientation
+        self._best_score = [[-np.inf] * len(ms) for ms in self.valid_metrics]
+        self._best_iter = [[0] * len(ms) for ms in self.valid_metrics]
+        self._best_msg = [[""] * len(ms) for ms in self.valid_metrics]
+        start_time = time.monotonic()
+        is_finished = False
+        iter0 = self.iter_
+        for it in range(iter0, cfg.num_iterations):
+            is_finished = self.train_one_iter()
+            if not is_finished:
+                is_finished = self._eval_and_check_early_stopping()
+            log.info("%f seconds elapsed, finished iteration %d",
+                     time.monotonic() - start_time, it + 1)
+            if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+                self.save_model_to_file(
+                    f"{output_model}.snapshot_iter_{it + 1}")
+            if is_finished:
+                break
+        self.finish_training()
+        if output_model:
+            self.save_model_to_file(output_model)
+            log.info("Finished training; model saved to %s", output_model)
+
+    def _eval_and_check_early_stopping(self) -> bool:
+        best_msg = self._output_metric(self.iter_)
+        if not best_msg:
+            return False
+        es = self.config.early_stopping_round
+        log.info("Early stopping at iteration %d, the best iteration "
+                 "round is %d", self.iter_, self.iter_ - es)
+        log.info("Output of best iteration round:\n%s", best_msg)
+        self._drop_last_iterations(es)
+        return True
+
+    def _output_metric(self, it: int) -> str:
+        """OutputMetric (gbdt.cpp:466-534): print metrics at metric_freq
+        and run the early-stopping bookkeeping; returns the best-round
+        message when the stop condition is met."""
+        cfg = self.config
+        need_output = cfg.metric_freq > 0 and (it % cfg.metric_freq) == 0
+        es_round = cfg.early_stopping_round
+        ret = ""
+        msg_lines: List[str] = []
+        if need_output:
+            for name, val, _ in self.get_eval_at(0):
+                line = f"Iteration:{it}, training {name} : {val:g}"
+                log.info("%s", line)
+                if es_round > 0:
+                    msg_lines.append(line)
+        met_best: List[tuple] = []
+        if need_output or es_round > 0:
+            for i in range(len(self.valid_sets)):
+                for j, (name, val, bigger) in enumerate(
+                        self.get_eval_at(i + 1)):
+                    line = (f"Iteration:{it}, valid_{i + 1} {name}"
+                            f" : {val:g}")
+                    if need_output:
+                        log.info("%s", line)
+                    if es_round > 0:
+                        msg_lines.append(line)
+                        cur = val if bigger else -val
+                        if cur > self._best_score[i][j]:
+                            self._best_score[i][j] = cur
+                            self._best_iter[i][j] = it
+                            met_best.append((i, j))
+                        elif not ret and \
+                                it - self._best_iter[i][j] >= es_round:
+                            ret = self._best_msg[i][j]
+        msg = "\n".join(msg_lines)
+        for i, j in met_best:
+            self._best_msg[i][j] = msg
+        return ret
 
     # -- feature importance (gbdt.cpp FeatureImportance) ---------------------
 
@@ -716,6 +928,8 @@ class GBDT:
                 cur.append(t)
         self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
         self.shrinkage_rate = 1.0  # already folded into leaf values
+        self._tree_shrinkage = [m.shrinkage if m.shrinkage else 1.0
+                                for m in self.models]
         return self
 
     def dump_model(self, start_iteration: int = 0,

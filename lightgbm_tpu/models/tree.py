@@ -147,31 +147,128 @@ class Tree:
                     return self.left_child[node]
         return self.right_child[node]
 
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        """Vectorized traversal over rows of raw feature values."""
+    def _traverse(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized level-synchronous traversal: all rows advance one
+        node per pass (numpy gathers replace the per-row while loop the
+        reference runs under OpenMP, tree.h:212-266)."""
         n = X.shape[0]
-        out = np.empty(n, np.float64)
         if self.num_leaves == 1:
-            out[:] = self.leaf_value[0]
-            return out
-        for i in range(n):
-            node = 0
-            while node >= 0:
-                node = self._decision(X[i, self.split_feature[node]], node)
-            out[i] = self.leaf_value[~node]
-        return out
+            return np.full(n, -1, np.int64)     # ~0: the single leaf
+        feat = np.asarray(self.split_feature, np.int64)
+        thresh = np.asarray(self.threshold, np.float64)
+        dtyp = np.asarray(self.decision_type, np.int64)
+        left = np.asarray(self.left_child, np.int64)
+        right = np.asarray(self.right_child, np.int64)
+        is_cat = (dtyp & K_CATEGORICAL_MASK) != 0
+        def_left = (dtyp & K_DEFAULT_LEFT_MASK) != 0
+        mtype = (dtyp >> 2) & 3
+        cat_bound = np.asarray(self.cat_boundaries, np.int64)
+        cat_words = np.asarray(self.cat_threshold, np.uint32)
+
+        node = np.zeros(n, np.int64)
+        active = np.arange(n)
+        while active.size:
+            cur = node[active]
+            fval = X[active, feat[cur]]
+            nan = np.isnan(fval)
+            mt = mtype[cur]
+            # numerical decision with missing handling (tree.h:183-201)
+            fz = np.where(nan & (mt != MissingType.NAN), 0.0, fval)
+            miss = ((mt == MissingType.ZERO)
+                    & (fz >= -1e-35) & (fz <= 1e-35)) \
+                | ((mt == MissingType.NAN) & nan)
+            go_left = np.where(miss, def_left[cur], fz <= thresh[cur])
+            if is_cat.any():
+                cat_rows = is_cat[cur]
+                if cat_rows.any():
+                    cc = cur[cat_rows]
+                    cv = fval[cat_rows]
+                    ok = ~np.isnan(cv) & (cv >= 0)
+                    cat = np.where(ok, cv, 0).astype(np.int64)
+                    ci = np.asarray(self.threshold_in_bin,
+                                    np.int64)[cc]
+                    lo, hi = cat_bound[ci], cat_bound[ci + 1]
+                    word = lo + cat // 32
+                    in_range = ok & (word < hi)
+                    bit = np.zeros(len(cc), bool)
+                    if in_range.any():
+                        w = cat_words[word[in_range]]
+                        bit[in_range] = (
+                            (w >> (cat[in_range] % 32)) & 1) != 0
+                    go_left[cat_rows] = bit
+            node[active] = np.where(go_left, left[cur], right[cur])
+            active = active[node[active] >= 0]
+        return node
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Raw leaf values per row (vectorized traversal)."""
+        leaves = ~self._traverse(np.asarray(X, np.float64))
+        return np.asarray(self.leaf_value, np.float64)[leaves]
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
-        n = X.shape[0]
-        out = np.zeros(n, np.int32)
+        return (~self._traverse(np.asarray(X, np.float64))).astype(np.int32)
+
+    # -- SHAP contributions (tree.h:118 PredictContrib) ----------------------
+
+    def expected_value(self, node: int = 0) -> float:
+        """Cover-weighted mean output of the (sub)tree — the SHAP base
+        value (tree.h ExpectedValue)."""
         if self.num_leaves == 1:
-            return out
-        for i in range(n):
-            node = 0
-            while node >= 0:
-                node = self._decision(X[i, self.split_feature[node]], node)
-            out[i] = ~node
-        return out
+            return self.leaf_value[0]
+        if node < 0:
+            return self.leaf_value[~node]
+        total = max(self.internal_count[node], 1)
+        lc, rc = self.left_child[node], self.right_child[node]
+        lw = (self.leaf_count[~lc] if lc < 0 else self.internal_count[lc])
+        rw = (self.leaf_count[~rc] if rc < 0 else self.internal_count[rc])
+        return (lw * self.expected_value(lc)
+                + rw * self.expected_value(rc)) / max(lw + rw, 1)
+
+    def predict_contrib(self, X: np.ndarray, out: np.ndarray) -> None:
+        """TreeSHAP (Lundberg & Lee): exact Shapley values for one tree,
+        accumulated into ``out`` [N, F+1]; last column is the bias.
+        Mirrors the reference's TreeSHAP port (tree.h PredictContrib /
+        tree.cpp TreeSHAP recursion)."""
+        X = np.asarray(X, np.float64)
+        base = self.expected_value()
+        out[:, -1] += base
+        if self.num_leaves == 1:
+            return
+        for i in range(X.shape[0]):
+            self._tree_shap(X[i], out[i], 0, [], 1.0, 1.0, -1)
+
+    def _node_cover(self, node: int) -> float:
+        return float(self.leaf_count[~node] if node < 0
+                     else self.internal_count[node])
+
+    def _tree_shap(self, x, phi, node, path, pzero, pone, pfeat):
+        # path: list of [feature, zero_frac, one_frac, pweight]
+        path = [p[:] for p in path]
+        _extend(path, pzero, pone, pfeat)
+        if node < 0:                       # leaf
+            leaf_v = self.leaf_value[~node]
+            for i in range(1, len(path)):
+                w = _unwound_sum(path, i)
+                phi[path[i][0]] += w * (path[i][2] - path[i][1]) * leaf_v
+            return
+        hot = self._decision(x[self.split_feature[node]], node)
+        cold = (self.right_child[node]
+                if hot == self.left_child[node] else self.left_child[node])
+        cover = self._node_cover(node)
+        hot_frac = self._node_cover(hot) / cover
+        cold_frac = self._node_cover(cold) / cover
+        incoming_zero, incoming_one = 1.0, 1.0
+        feat = self.split_feature[node]
+        path_idx = next((i for i in range(1, len(path))
+                         if path[i][0] == feat), -1)
+        if path_idx >= 0:
+            incoming_zero = path[path_idx][1]
+            incoming_one = path[path_idx][2]
+            _unwind(path, path_idx)
+        self._tree_shap(x, phi, hot, path,
+                        incoming_zero * hot_frac, incoming_one, feat)
+        self._tree_shap(x, phi, cold, path,
+                        incoming_zero * cold_frac, 0.0, feat)
 
     # -- serialization (src/io/tree.cpp:209-243) ----------------------------
 
@@ -285,6 +382,52 @@ class Tree:
         self.leaf_value[leaf] = value
 
 
+def _extend(path, pzero, pone, pfeat):
+    """TreeSHAP ExtendPath: grow the feature path by one split."""
+    path.append([pfeat, pzero, pone, 1.0 if len(path) == 0 else 0.0])
+    n = len(path) - 1
+    for i in range(n - 1, -1, -1):
+        path[i + 1][3] += pone * path[i][3] * (i + 1) / (n + 1)
+        path[i][3] = pzero * path[i][3] * (n - i) / (n + 1)
+
+
+def _unwind(path, path_idx):
+    """TreeSHAP UnwindPath: remove the split at path_idx."""
+    n = len(path) - 1
+    pone = path[path_idx][2]
+    pzero = path[path_idx][1]
+    next_one = path[n][3]
+    for i in range(n - 1, -1, -1):
+        if pone != 0:
+            tmp = path[i][3]
+            path[i][3] = next_one * (n + 1) / ((i + 1) * pone)
+            next_one = tmp - path[i][3] * pzero * (n - i) / (n + 1)
+        else:
+            path[i][3] = path[i][3] * (n + 1) / (pzero * (n - i))
+    for i in range(path_idx, n):
+        path[i][0] = path[i + 1][0]
+        path[i][1] = path[i + 1][1]
+        path[i][2] = path[i + 1][2]
+    path.pop()
+
+
+def _unwound_sum(path, path_idx):
+    """TreeSHAP UnwoundPathSum: total weight had path_idx been skipped."""
+    n = len(path) - 1
+    pone = path[path_idx][2]
+    pzero = path[path_idx][1]
+    next_one = path[n][3]
+    total = 0.0
+    for i in range(n - 1, -1, -1):
+        if pone != 0:
+            tmp = next_one * (n + 1) / ((i + 1) * pone)
+            total += tmp
+            next_one = path[i][3] - tmp * pzero * ((n - i) / (n + 1))
+        elif pzero != 0:
+            total += (path[i][3] / pzero) * (n + 1) / (n - i)
+    return total
+
+
 def _fmt_float(x) -> str:
     return np.format_float_positional(
         np.float32(x), unique=True, trim="0") if np.isfinite(x) else str(x)
@@ -294,6 +437,66 @@ def _fmt_double(x) -> str:
     if not np.isfinite(x):
         return str(x)
     return repr(float(x))
+
+
+def record_arrays_from_tree(tree: Tree, real_to_inner: dict, mappers,
+                            max_leaves: int) -> dict:
+    """Inverse of ``tree_from_record``: host Tree -> TreeRecord-shaped
+    numpy arrays in bin space, so loaded models get device-resident
+    records (fast prediction + continued training; the reference
+    rebuilds its in-memory model the same way in
+    GBDT::LoadModelFromString, gbdt_model_text.cpp:339-450).
+
+    Split order: node i IS split i (Tree::Split numbering), and the leaf
+    a node split is recovered by descending left children to a leaf —
+    when leaf ``l`` is re-split, its left child keeps slot ``l``.
+    Thresholds return to bin space through the mapper: thresholds are
+    bin upper bounds, so ``value_to_bin`` is exact on the same mappers.
+    """
+    L = max_leaves
+    nl = tree.num_leaves
+    if nl > L:
+        log.fatal(f"Loaded tree has {nl} leaves > num_leaves cap {L}; "
+                  "raise num_leaves to continue training this model")
+    s = max(L - 1, 1)
+    out = {
+        "num_leaves": np.int32(nl),
+        "split_leaf": np.full(s, -1, np.int32),
+        "split_feature": np.zeros(s, np.int32),
+        "split_bin": np.zeros(s, np.int32),
+        "split_gain": np.zeros(s, np.float32),
+        "split_default_left": np.zeros(s, bool),
+        "leaf_output": np.zeros(L, np.float32),
+        "leaf_count": np.zeros(L, np.float32),
+        "leaf_sum_g": np.zeros(L, np.float32),
+        "leaf_sum_h": np.zeros(L, np.float32),
+        "internal_value": np.zeros(s, np.float32),
+        "internal_count": np.zeros(s, np.float32),
+    }
+    for i in range(nl - 1):
+        if tree.decision_type[i] & K_CATEGORICAL_MASK:
+            log.fatal("Continued training from categorical splits is not "
+                      "supported yet")
+        c = tree.left_child[i]
+        while c >= 0:
+            c = tree.left_child[c]
+        out["split_leaf"][i] = ~c
+        real = tree.split_feature[i]
+        inner = real_to_inner.get(real)
+        if inner is None:
+            log.fatal(f"Loaded model splits on feature {real} which is "
+                      "trivial/unused in the new training data")
+        out["split_feature"][i] = inner
+        out["split_bin"][i] = int(mappers[inner].value_to_bin(
+            np.asarray([tree.threshold[i]]))[0])
+        out["split_gain"][i] = tree.split_gain[i]
+        out["split_default_left"][i] = bool(
+            tree.decision_type[i] & K_DEFAULT_LEFT_MASK)
+        out["internal_value"][i] = tree.internal_value[i]
+        out["internal_count"][i] = tree.internal_count[i]
+    out["leaf_output"][:nl] = tree.leaf_value[:nl]
+    out["leaf_count"][:nl] = tree.leaf_count[:nl]
+    return out
 
 
 def tree_from_record(rec, mappers, real_features, shrinkage: float,

@@ -280,6 +280,11 @@ class RegressionTweedieLoss(RegressionPoissonLoss):
 class BinaryLogloss(ObjectiveFunction):
     name = "binary"
 
+    def __init__(self, config):
+        super().__init__(config)
+        # needed by convert_output on loaded models (no init() there)
+        self.sigmoid = config.sigmoid
+
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
         is_pos = self.label > 0
@@ -334,6 +339,11 @@ class BinaryLogloss(ObjectiveFunction):
 
 class MulticlassSoftmax(ObjectiveFunction):
     name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        # needed by to_string/convert_output on loaded models
+        self.num_class = config.num_class
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)

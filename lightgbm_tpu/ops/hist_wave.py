@@ -53,8 +53,17 @@ def _round_up(x: int, m: int) -> int:
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk",
                                              "precision"))
 def wave_histogram_xla(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
-                       chunk=65536, precision="highest"):
+                       chunk=0, precision="highest"):
     """[W, F, B, 3] histograms of the rows of each wave leaf.
+
+    Scatter-add formulation: each (row, feature) contributes its
+    (g, h, 1) to flat index ``slot*F*B + f*B + bin``. This is the
+    CPU/any-backend correctness oracle — XLA lowers the scatter to a
+    sequential loop, which is fast on CPU and exactly associative; the
+    MXU one-hot design lives in the Pallas kernel below. (The previous
+    oracle materialized the [F, N, B] one-hot through memory — hundreds
+    of MB per pass.) ``chunk``/``precision`` are accepted for interface
+    parity with the Pallas path; the scatter needs neither.
 
     Args:
       bins_t:      [F, N] integer bin matrix, feature-major (uint8/int32).
@@ -68,39 +77,22 @@ def wave_histogram_xla(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     F, n = bins_t.shape
     W = wave_leaves.shape[0]
     B = num_bins
-    pad = (-n) % chunk
-    if pad:
-        bins_t = jnp.pad(bins_t, ((0, 0), (0, pad)))
-        g = jnp.pad(g, (0, pad))
-        h = jnp.pad(h, (0, pad))
-        leaf_ids = jnp.pad(leaf_ids, (0, pad), constant_values=-1)
-    n_chunks = (n + pad) // chunk
+    eq = (leaf_ids[None, :] == wave_leaves[:, None]) \
+        & (wave_leaves >= 0)[:, None]                     # [W, N]
+    found = eq.any(axis=0)
+    slot = jnp.argmax(eq, axis=0).astype(jnp.int32)       # [N]
+    base = jnp.where(found, slot * (F * B), W * F * B)    # OOB -> dropped
+    flat = (base[None, :] + jnp.arange(F, dtype=jnp.int32)[:, None] * B
+            + bins_t.astype(jnp.int32)).ravel()           # [F*N]
+    size = W * F * B
 
-    bins_c = bins_t.astype(jnp.int32).reshape(F, n_chunks, chunk)
-    bins_c = jnp.moveaxis(bins_c, 1, 0)                    # [nc, F, chunk]
-    g_c = g.astype(jnp.float32).reshape(n_chunks, chunk)
-    h_c = h.astype(jnp.float32).reshape(n_chunks, chunk)
-    l_c = leaf_ids.astype(jnp.int32).reshape(n_chunks, chunk)
+    def scat(vals):
+        v = jnp.broadcast_to(vals.astype(jnp.float32), (F, n)).ravel()
+        return jnp.zeros(size, jnp.float32).at[flat].add(v, mode="drop")
 
-    def body(acc, args):
-        b, gc, hc, lc = args
-        m = (lc[:, None] == wave_leaves[None, :]).astype(jnp.float32)
-        m = m * (wave_leaves >= 0)[None, :]
-        # [chunk, 3W]: W grad cols, W hess cols, W count cols
-        w = jnp.concatenate([m * gc[:, None], m * hc[:, None], m], axis=1)
-        oh = jax.nn.one_hot(b, B, dtype=jnp.float32)       # [F, chunk, B]
-        # TPU default matmul precision multiplies in bf16, which rounds
-        # grad/hess; "highest" keeps true f32 products like the
-        # reference's f32 histogram accumulation (GPU-Performance.rst).
-        hsum = jnp.einsum("fcb,cw->fbw", oh, w,
-                          precision=precision,
-                          preferred_element_type=jnp.float32)  # [F, B, 3W]
-        return acc + hsum, None
-
-    init = jnp.zeros((F, B, 3 * W), jnp.float32)
-    hist, _ = jax.lax.scan(body, init, (bins_c, g_c, h_c, l_c))
-    # [F, B, 3, W] -> [W, F, B, 3]
-    return hist.reshape(F, B, 3, W).transpose(3, 0, 1, 2)
+    hist = jnp.stack([scat(g), scat(h),
+                      scat(jnp.ones((), jnp.float32))], axis=1)
+    return hist.reshape(W, F, B, 3)
 
 
 # ---------------------------------------------------------------------------
@@ -273,7 +265,7 @@ def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
             chunk=chunk or 2048, precision=precision)
     return wave_histogram_xla(
         bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
-        chunk=chunk or 65536, precision=precision)
+        chunk=0, precision=precision)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,222 @@
+"""CLI application + text loader tests.
+
+Covers the reference Application/Parser/DatasetLoader behaviors
+(reference: src/application/application.cpp:64-281, src/io/parser.cpp,
+src/io/dataset_loader.cpp:161-499): config files, train/predict tasks,
+TSV/CSV/LibSVM autodetect, sidecar weight/query files, header columns.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.application import Application, parse_config_file
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.loader import DatasetLoader
+from lightgbm_tpu.io.parser import detect_format, parse_file
+
+
+def _write_tsv(path, X, y):
+    with open(path, "w") as fh:
+        for i in range(len(y)):
+            fh.write("\t".join(
+                [f"{y[i]:g}"] + [f"{v:.6f}" for v in X[i]]) + "\n")
+
+
+def _make_data(n=300, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+class TestParser:
+    def test_detect_format(self):
+        assert detect_format(["1\t2\t3", "4\t5\t6"]) == "tsv"
+        assert detect_format(["1,2,3", "4,5,6"]) == "csv"
+        assert detect_format(["1 0:2 3:4", "0 1:1"]) == "libsvm"
+
+    def test_parse_tsv(self, tmp_path):
+        X, y = _make_data(50)
+        p = str(tmp_path / "d.tsv")
+        _write_tsv(p, X, y)
+        parsed, names = parse_file(p, label_idx=0)
+        assert parsed.num_data == 50
+        assert parsed.num_columns == 6
+        np.testing.assert_allclose(parsed.label, y, atol=1e-6)
+        np.testing.assert_allclose(parsed.values, X, atol=1e-5)
+
+    def test_parse_csv_header(self, tmp_path):
+        X, y = _make_data(30)
+        p = str(tmp_path / "d.csv")
+        with open(p, "w") as fh:
+            fh.write("target," + ",".join(
+                f"x{i}" for i in range(X.shape[1])) + "\n")
+            for i in range(len(y)):
+                fh.write(",".join(
+                    [f"{y[i]:g}"] + [f"{v:.5f}" for v in X[i]]) + "\n")
+        parsed, names = parse_file(p, header=True, label_idx=0)
+        assert names == [f"x{i}" for i in range(X.shape[1])]
+        assert parsed.num_columns == X.shape[1]
+
+    def test_parse_libsvm(self, tmp_path):
+        p = str(tmp_path / "d.svm")
+        with open(p, "w") as fh:
+            fh.write("1 0:0.5 2:1.5\n0 1:2.0\n1 0:1.0 1:1.0 2:1.0\n")
+        parsed, _ = parse_file(p, label_idx=0)
+        assert parsed.values.shape == (3, 3)
+        np.testing.assert_allclose(parsed.label, [1, 0, 1])
+        assert parsed.values[1, 1] == 2.0
+        assert parsed.values[1, 0] == 0.0
+
+    def test_label_inference_for_prediction(self, tmp_path):
+        # rows with exactly num_features columns -> no label column
+        p = str(tmp_path / "d.tsv")
+        with open(p, "w") as fh:
+            fh.write("0.1\t0.2\t0.3\n0.4\t0.5\t0.6\n")
+        parsed, _ = parse_file(p, label_idx=0, num_features_hint=3)
+        assert parsed.label is None
+        assert parsed.num_columns == 3
+
+
+class TestLoader:
+    def test_sidecar_weight_query(self, tmp_path):
+        X, y = _make_data(60)
+        data = str(tmp_path / "train.txt")
+        _write_tsv(data, X, y)
+        with open(data + ".weight", "w") as fh:
+            for i in range(60):
+                fh.write(f"{1.0 + (i % 3)}\n")
+        with open(data + ".query", "w") as fh:
+            fh.write("30\n30\n")
+        cfg = Config()
+        ds = DatasetLoader(cfg).load_from_file(data)
+        assert ds.metadata.weights is not None
+        assert ds.metadata.weights[1] == pytest.approx(2.0)
+        assert ds.metadata.num_queries == 2
+
+    def test_ignore_and_weight_column(self, tmp_path):
+        X, y = _make_data(80)
+        data = str(tmp_path / "t.csv")
+        with open(data, "w") as fh:
+            fh.write("label,w,a,b,c,d,e,f\n")
+            for i in range(80):
+                fh.write(",".join(
+                    [f"{y[i]:g}", f"{1 + i % 2}"]
+                    + [f"{v:.5f}" for v in X[i]]) + "\n")
+        cfg = Config()
+        cfg.set({"header": True, "label_column": "name:label",
+                 "weight_column": "name:w", "ignore_column": "name:a"})
+        ds = DatasetLoader(cfg).load_from_file(data)
+        assert ds.metadata.weights[1] == pytest.approx(2.0)
+        # 6 X columns minus the ignored one
+        assert ds.num_total_features == 5
+
+    def test_binary_cache(self, tmp_path, monkeypatch):
+        X, y = _make_data(50)
+        data = str(tmp_path / "train.txt")
+        _write_tsv(data, X, y)
+        cfg = Config()
+        cfg.save_binary = True
+        ds = DatasetLoader(cfg).load_from_file(data)
+        assert os.path.exists(data + ".bin")
+        ds2 = DatasetLoader(Config()).load_from_file(data + ".bin")
+        np.testing.assert_array_equal(ds.bins, ds2.bins)
+
+
+class TestApplication:
+    def _write_conf(self, tmp_path, X, y, Xv, yv, extra=""):
+        train = str(tmp_path / "train.txt")
+        valid = str(tmp_path / "valid.txt")
+        _write_tsv(train, X, y)
+        _write_tsv(valid, Xv, yv)
+        conf = str(tmp_path / "train.conf")
+        with open(conf, "w") as fh:
+            fh.write(f"""
+task = train
+objective = binary
+metric = binary_logloss,auc   # two metrics
+is_training_metric = true
+data = train.txt
+valid_data = valid.txt
+num_trees = 5
+learning_rate = 0.1
+num_leaves = 15
+min_data_in_leaf = 5
+metric_freq = 5
+output_model = {tmp_path}/model.txt
+{extra}
+""")
+        return conf
+
+    def test_train_and_predict_tasks(self, tmp_path, capsys):
+        X, y = _make_data(200)
+        Xv, yv = _make_data(80, seed=1)
+        conf = self._write_conf(tmp_path, X, y, Xv, yv)
+        cwd = os.getcwd()
+        os.chdir(tmp_path)       # data paths resolve relative to config
+        try:
+            Application([f"config={conf}"]).run()
+            model = str(tmp_path / "model.txt")
+            assert os.path.exists(model)
+            text = open(model).read()
+            assert text.startswith("tree")
+            assert "Tree=4" in text
+            # predict task
+            out = str(tmp_path / "preds.txt")
+            Application([
+                "task=predict", f"data={tmp_path}/valid.txt",
+                f"input_model={model}", f"output_result={out}",
+            ]).run()
+            preds = np.loadtxt(out)
+            assert preds.shape == (80,)
+            assert preds.min() >= 0 and preds.max() <= 1
+            auc_input = preds[yv > 0].mean() > preds[yv == 0].mean()
+            assert auc_input
+        finally:
+            os.chdir(cwd)
+
+    def test_cli_continue_training(self, tmp_path):
+        X, y = _make_data(200)
+        Xv, yv = _make_data(80, seed=1)
+        conf = self._write_conf(tmp_path, X, y, Xv, yv)
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            Application([f"config={conf}"]).run()
+            model = str(tmp_path / "model.txt")
+            out2 = str(tmp_path / "model2.txt")
+            Application([f"config={conf}", f"input_model={model}",
+                         f"output_model={out2}", "num_trees=8"]).run()
+            text = open(out2).read()
+            assert "Tree=7" in text      # 5 loaded + 3 new
+            assert "Tree=8" not in text
+        finally:
+            os.chdir(cwd)
+
+    def test_convert_model_task(self, tmp_path):
+        X, y = _make_data(150)
+        Xv, yv = _make_data(50, seed=2)
+        conf = self._write_conf(tmp_path, X, y, Xv, yv)
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            Application([f"config={conf}"]).run()
+            cpp = str(tmp_path / "model.cpp")
+            Application([
+                "task=convert_model",
+                f"input_model={tmp_path}/model.txt",
+                f"convert_model={cpp}"]).run()
+            code = open(cpp).read()
+            assert "double PredictTree0" in code
+            assert "PredictRaw" in code
+        finally:
+            os.chdir(cwd)
+
+    def test_parse_config_file(self, tmp_path):
+        conf = str(tmp_path / "c.conf")
+        with open(conf, "w") as fh:
+            fh.write("# comment\nnum_trees = 7\nmetric = auc # tail\n")
+        kv = parse_config_file(conf)
+        assert kv["num_trees"] == "7"
+        assert kv["metric"] == "auc"

@@ -1,0 +1,409 @@
+"""User-facing python package tests.
+
+Port of the reference acceptance suite
+(reference: tests/python_package_test/test_engine.py:28-square,
+test_basic.py, test_sklearn.py) against lightgbm_tpu's
+Dataset/Booster/train/cv surface. Datasets are scaled down so the CPU
+test backend stays fast; thresholds scale accordingly.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=400, f=10, seed=42):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 1.5 + X[:, 1] - 0.5 * X[:, 2]
+    y = (logit + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def _regression_data(n=400, f=8, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2.0 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def _logloss(y, p):
+    p = np.clip(p, 1e-15, 1 - 1e-15)
+    return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+class TestEngine:
+    """test_engine.py ports."""
+
+    def test_binary(self):
+        # test_engine.py:28-48 (num_iteration in params wins)
+        X, y = _binary_data()
+        Xt, yt = _binary_data(seed=43)
+        params = {"objective": "binary", "metric": "binary_logloss",
+                  "verbose": -1, "num_iteration": 30}
+        lgb_train = lgb.Dataset(X, y)
+        lgb_eval = lgb.Dataset(Xt, yt, reference=lgb_train)
+        evals_result = {}
+        gbm = lgb.train(params, lgb_train, num_boost_round=20,
+                        valid_sets=lgb_eval, verbose_eval=False,
+                        evals_result=evals_result)
+        ret = _logloss(yt, gbm.predict(Xt))
+        assert ret < 0.35
+        assert len(evals_result["valid_0"]["binary_logloss"]) == 30
+        assert evals_result["valid_0"]["binary_logloss"][-1] == \
+            pytest.approx(ret, abs=1e-4)
+
+    def test_regression(self):
+        # test_engine.py:75-93
+        X, y = _regression_data()
+        Xt, yt = _regression_data(seed=8)
+        params = {"metric": "l2", "verbose": -1}
+        lgb_train = lgb.Dataset(X, y)
+        lgb_eval = lgb.Dataset(Xt, yt, reference=lgb_train)
+        evals_result = {}
+        gbm = lgb.train(params, lgb_train, num_boost_round=30,
+                        valid_sets=lgb_eval, verbose_eval=False,
+                        evals_result=evals_result)
+        ret = float(np.mean((yt - gbm.predict(Xt)) ** 2))
+        assert ret < 1.0
+        assert evals_result["valid_0"]["l2"][-1] == \
+            pytest.approx(ret, abs=1e-4)
+
+    def test_multiclass(self):
+        # test_engine.py:290-310
+        rng = np.random.default_rng(0)
+        n = 300
+        y = rng.integers(0, 3, n).astype(np.float64)
+        X = rng.normal(size=(n, 6))
+        X[:, 0] += 2 * y
+        X[:, 1] -= 2 * y
+        params = {"objective": "multiclass", "metric": "multi_logloss",
+                  "num_class": 3, "verbose": -1}
+        lgb_train = lgb.Dataset(X, y)
+        evals_result = {}
+        gbm = lgb.train(params, lgb_train, num_boost_round=20,
+                        valid_sets=lgb.Dataset(X, y, reference=lgb_train),
+                        verbose_eval=False, evals_result=evals_result)
+        pred = gbm.predict(X)
+        assert pred.shape == (n, 3)
+        assert (pred.argmax(axis=1) == y).mean() > 0.9
+        assert evals_result["valid_0"]["multi_logloss"][-1] < 0.6
+
+    def test_missing_value_handle(self):
+        # test_engine.py:94-118: NaN rows learn their own leaf
+        X = np.zeros((500, 1))
+        y = np.zeros(500)
+        rng = np.random.default_rng(3)
+        trues = rng.choice(500, 100, replace=False)
+        X[trues, 0] = np.nan
+        y[trues] = 1
+        params = {"metric": "l2", "verbose": -1,
+                  "boost_from_average": False,
+                  "min_data_in_leaf": 1}
+        gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=20,
+                        verbose_eval=False)
+        ret = float(np.mean((y - gbm.predict(X)) ** 2))
+        assert ret < 0.005
+
+    def test_early_stopping(self):
+        # test_engine.py:364-394
+        X, y = _binary_data()
+        Xt, yt = _binary_data(seed=99)
+        params = {"objective": "binary", "metric": "binary_logloss",
+                  "verbose": -1}
+        lgb_train = lgb.Dataset(X, y)
+        lgb_eval = lgb.Dataset(Xt, yt, reference=lgb_train)
+        valid_set_name = "valid_set"
+        # no early stopping without improvement stop
+        gbm = lgb.train(params, lgb_train, num_boost_round=10,
+                        valid_sets=lgb_eval, valid_names=valid_set_name,
+                        verbose_eval=False, early_stopping_rounds=5)
+        assert gbm.best_iteration > 0
+        assert valid_set_name in gbm.best_score
+        assert "binary_logloss" in gbm.best_score[valid_set_name]
+        # early stopping should trigger well before 400 rounds
+        gbm = lgb.train(params, lgb_train, num_boost_round=400,
+                        valid_sets=lgb_eval, valid_names=valid_set_name,
+                        verbose_eval=False, early_stopping_rounds=5)
+        assert gbm.best_iteration < 400
+
+    def test_continue_train(self):
+        # test_engine.py:395-423: init_model continuation via file
+        X, y = _regression_data()
+        Xt, yt = _regression_data(seed=8)
+        params = {"objective": "regression", "metric": "l1",
+                  "verbose": -1}
+        lgb_train = lgb.Dataset(X, y, free_raw_data=False)
+        lgb_eval = lgb.Dataset(Xt, yt, reference=lgb_train,
+                               free_raw_data=False)
+        init_gbm = lgb.train(params, lgb_train, num_boost_round=10,
+                             verbose_eval=False)
+        model_name = "model.txt"
+        init_gbm.save_model(model_name)
+        try:
+            evals_result = {}
+            gbm = lgb.train(params, lgb_train, num_boost_round=20,
+                            valid_sets=lgb_eval, verbose_eval=False,
+                            evals_result=evals_result,
+                            init_model="model.txt")
+            ret = float(np.mean(np.abs(yt - (
+                init_gbm.predict(Xt) + gbm.predict(Xt)))))
+            assert ret < 0.6
+            assert evals_result["valid_0"]["l1"][-1] == \
+                pytest.approx(ret, abs=1e-4)
+            for l1 in evals_result["valid_0"]["l1"]:
+                assert l1 < 2.0
+        finally:
+            os.remove(model_name)
+
+    def test_cv(self):
+        # test_engine.py:447-496 (subset)
+        X, y = _regression_data()
+        params = {"verbose": -1}
+        lgb_train = lgb.Dataset(X, y, free_raw_data=False)
+        # shuffle = False, override metric in params
+        params_with_metric = {"metric": "l2", "verbose": -1}
+        cv_res = lgb.cv(params_with_metric, lgb_train,
+                        num_boost_round=8, nfold=3, stratified=False,
+                        shuffle=False, metrics="l1", verbose_eval=False)
+        assert "l1-mean" in cv_res
+        assert "l2-mean" not in cv_res
+        assert len(cv_res["l1-mean"]) == 8
+        # shuffle = True, callbacks
+        cv_res = lgb.cv(params, lgb_train, num_boost_round=8, nfold=3,
+                        stratified=False, shuffle=True, metrics="l1",
+                        verbose_eval=False,
+                        callbacks=[lgb.reset_parameter(
+                            learning_rate=lambda i: 0.1 - 0.001 * i)])
+        assert "l1-mean" in cv_res
+        assert len(cv_res["l1-mean"]) == 8
+        # self defined folds
+        from sklearn.model_selection import KFold
+        folds = KFold(n_splits=3)
+        cv_res = lgb.cv(params_with_metric, lgb_train, num_boost_round=8,
+                        folds=folds, verbose_eval=False)
+        assert "l2-mean" in cv_res
+        # lambdarank (group-aware folds)
+        rng = np.random.default_rng(1)
+        q = np.full(20, 15)
+        Xr = rng.normal(size=(300, 5))
+        yr = rng.integers(0, 4, 300).astype(np.float64)
+        params_rank = {"objective": "lambdarank", "verbose": -1,
+                       "eval_at": [3]}
+        lgb_rank = lgb.Dataset(Xr, yr, group=q, free_raw_data=False)
+        cv_res = lgb.cv(params_rank, lgb_rank, num_boost_round=4,
+                        nfold=2, metrics="ndcg", verbose_eval=False)
+        assert "ndcg@3-mean" in cv_res
+        assert len(cv_res["ndcg@3-mean"]) == 4
+
+    def test_feature_name(self):
+        # test_engine.py:497-509
+        X, y = _regression_data()
+        params = {"verbose": -1}
+        lgb_train = lgb.Dataset(X, y)
+        feature_names = [f"f_{i}" for i in range(X.shape[1])]
+        gbm = lgb.train(params, lgb_train, num_boost_round=3,
+                        feature_name=feature_names, verbose_eval=False)
+        assert feature_names == gbm.feature_name()
+        # no exception with non-ascii
+        feature_names = ["F_零", "F_一", "F_二", "F_三", "F_四",
+                         "F_五", "F_六", "F_七"]
+        gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=3,
+                        feature_name=feature_names, verbose_eval=False)
+        assert feature_names == gbm.feature_name()
+
+    def test_save_load_copy_pickle(self):
+        # test_engine.py:510-541
+        X, y = _regression_data()
+        params = {"objective": "regression", "metric": "l2",
+                  "verbose": -1}
+        gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                        verbose_eval=False)
+        ret_origin = float(np.mean((y - gbm.predict(X)) ** 2))
+
+        gbm.save_model("model_pkl.txt")
+        try:
+            for option in range(4):
+                if option == 0:
+                    model = lgb.Booster(model_file="model_pkl.txt")
+                elif option == 1:
+                    model = lgb.Booster(
+                        model_str=gbm.model_to_string())
+                elif option == 2:
+                    model = pickle.loads(pickle.dumps(gbm))
+                else:
+                    import copy
+                    model = copy.deepcopy(gbm)
+                ret = float(np.mean((y - model.predict(X)) ** 2))
+                assert ret_origin == pytest.approx(ret, abs=1e-5)
+        finally:
+            os.remove("model_pkl.txt")
+
+    def test_contribs(self):
+        # test_engine.py:598-612: SHAP sums to raw prediction
+        X, y = _binary_data(n=200)
+        params = {"objective": "binary", "verbose": -1}
+        gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
+                        verbose_eval=False)
+        contribs = gbm.predict(X, pred_contrib=True)
+        raw = gbm.predict(X, raw_score=True)
+        assert contribs.shape == (X.shape[0], X.shape[1] + 1)
+        np.testing.assert_allclose(contribs.sum(axis=1), raw,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_constant_features(self):
+        # test_engine.py:753-804: all-constant features -> prior
+        y = np.array([0.0, 10.0, 0.0, 10.0])
+        X = np.zeros((4, 2))
+        params = {"objective": "regression_l2", "min_data_in_leaf": 1,
+                  "min_data_in_bin": 1, "boost_from_average": True,
+                  "verbose": -1}
+        gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=2,
+                        verbose_eval=False)
+        np.testing.assert_allclose(gbm.predict(X), np.full(4, 5.0),
+                                   atol=1e-5)
+
+    def test_fobj_feval(self):
+        # custom objective + custom metric (test_engine.py advanced)
+        X, y = _regression_data()
+
+        def loglikelihood(preds, train_data):
+            labels = train_data.get_label()
+            grad = preds - labels
+            hess = np.ones_like(preds)
+            return grad, hess
+
+        def custom_l2(preds, train_data):
+            labels = train_data.get_label()
+            return "custom_l2", float(np.mean((preds - labels) ** 2)), \
+                False
+
+        params = {"objective": "none", "verbose": -1,
+                  "boost_from_average": False}
+        evals_result = {}
+        lgb_train = lgb.Dataset(X, y, free_raw_data=False)
+        gbm = lgb.train(params, lgb_train, num_boost_round=15,
+                        valid_sets=[lgb_train], valid_names=["train"],
+                        fobj=loglikelihood, feval=custom_l2,
+                        verbose_eval=False, evals_result=evals_result)
+        assert evals_result["train"]["custom_l2"][-1] < \
+            evals_result["train"]["custom_l2"][0]
+
+    def test_reset_parameter_callback(self):
+        X, y = _regression_data()
+        lrs = []
+
+        def spy(env):
+            lrs.append(env.params.get("learning_rate"))
+        gbm = lgb.train({"verbose": -1, "metric": "l2"},
+                        lgb.Dataset(X, y), num_boost_round=5,
+                        learning_rates=lambda i: 0.2 * (0.5 ** i),
+                        callbacks=[spy], verbose_eval=False)
+        assert gbm.current_iteration() == 5
+
+
+class TestBasic:
+    """test_basic.py ports."""
+
+    def test_dataset_fields(self):
+        X, y = _binary_data(n=100)
+        w = np.linspace(0.5, 1.5, 100)
+        ds = lgb.Dataset(X, label=y, weight=w, free_raw_data=False)
+        ds.construct()
+        np.testing.assert_allclose(ds.get_label(), y, rtol=1e-6)
+        np.testing.assert_allclose(ds.get_weight(), w, rtol=1e-6)
+        assert ds.num_data() == 100
+        assert ds.num_feature() == X.shape[1]
+        assert ds.get_field("label") is ds.get_label()
+
+    def test_save_binary_roundtrip(self, tmp_path):
+        X, y = _binary_data(n=100)
+        ds = lgb.Dataset(X, label=y)
+        path = str(tmp_path / "ds.bin")
+        ds.save_binary(path)
+        ds2 = lgb.Dataset(path)
+        ds2.construct()
+        assert ds2.num_data() == 100
+        np.testing.assert_allclose(ds2.get_label(), y.astype(np.float32))
+        gbm = lgb.train({"objective": "binary", "verbose": -1}, ds2,
+                        num_boost_round=3, verbose_eval=False)
+        assert gbm.current_iteration() == 3
+
+    def test_subset(self):
+        X, y = _binary_data(n=200)
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        sub = ds.subset(np.arange(50))
+        sub.construct()
+        assert sub.num_data() == 50
+
+    def test_pandas_dataframe(self):
+        pd = pytest.importorskip("pandas")
+        X, y = _binary_data(n=150)
+        df = pd.DataFrame(X, columns=[f"c{i}" for i in range(X.shape[1])])
+        df["cat"] = pd.Categorical(
+            np.random.default_rng(0).integers(0, 3, 150))
+        ds = lgb.Dataset(df, label=pd.Series(y))
+        gbm = lgb.train({"objective": "binary", "verbose": -1}, ds,
+                        num_boost_round=3, verbose_eval=False)
+        assert gbm.feature_name()[:2] == ["c0", "c1"]
+        pred = gbm.predict(df)
+        assert pred.shape == (150,)
+
+
+class TestSklearn:
+    """test_sklearn.py ports."""
+
+    def test_classifier(self):
+        X, y = _binary_data()
+        clf = lgb.LGBMClassifier(n_estimators=10, verbose=-1)
+        clf.fit(X, y.astype(int), verbose=False)
+        assert (clf.predict(X) == y).mean() > 0.9
+        proba = clf.predict_proba(X)
+        assert proba.shape == (len(y), 2)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+        assert len(clf.feature_importances_) == X.shape[1]
+
+    def test_regressor(self):
+        X, y = _regression_data()
+        reg = lgb.LGBMRegressor(n_estimators=20, verbose=-1)
+        reg.fit(X, y, verbose=False)
+        assert reg.score(X, y) > 0.8
+
+    def test_multiclass_sklearn(self):
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 3, 300)
+        X = rng.normal(size=(300, 5))
+        X[:, 0] += 2 * y
+        clf = lgb.LGBMClassifier(n_estimators=10, verbose=-1)
+        clf.fit(X, y, verbose=False)
+        assert clf.n_classes_ == 3
+        assert clf.predict_proba(X).shape == (300, 3)
+        assert (clf.predict(X) == y).mean() > 0.8
+
+    def test_ranker(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(200, 5))
+        y = rng.integers(0, 4, 200)
+        group = np.full(10, 20)
+        rk = lgb.LGBMRanker(n_estimators=5, verbose=-1)
+        rk.fit(X, y, group=group, verbose=False)
+        assert rk.predict(X).shape == (200,)
+
+    def test_early_stopping_sklearn(self):
+        X, y = _binary_data()
+        Xt, yt = _binary_data(seed=11)
+        clf = lgb.LGBMClassifier(n_estimators=200, verbose=-1)
+        clf.fit(X, y.astype(int), eval_set=[(Xt, yt.astype(int))],
+                eval_metric="binary_logloss", early_stopping_rounds=5,
+                verbose=False)
+        assert clf.best_iteration_ is not None
+        assert clf.best_iteration_ < 200
+
+    def test_sklearn_clone_and_grid(self):
+        from sklearn.base import clone
+        est = lgb.LGBMRegressor(n_estimators=5, num_leaves=7)
+        est2 = clone(est)
+        assert est2.get_params()["num_leaves"] == 7
