@@ -22,6 +22,7 @@ import numpy as np
 from .config import Config
 from .io.loader import DatasetLoader
 from .metrics import create_metrics
+from .models.boosting import create_boosting
 from .models.gbdt import GBDT
 from .objectives import create_objective
 from .utils import log
@@ -112,7 +113,7 @@ class Application:
             train_metrics = create_metrics(
                 metric_names, cfg, train_data.metadata, train_data.num_data)
 
-        booster = GBDT()
+        booster = create_boosting(cfg.boosting_type())
         if cfg.input_model:
             with open(_rel_to_config(cfg, cfg.input_model)) as fh:
                 booster.load_model_from_string(fh.read())

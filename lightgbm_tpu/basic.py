@@ -457,9 +457,12 @@ class Booster:
     # -- init ---------------------------------------------------------------
 
     def _init_from_train_set(self, train_set: Dataset):
-        from .models.gbdt import GBDT
+        from .models.boosting import create_boosting
         cfg = Config()
         cfg.set(self.params)
+        if cfg.verbosity < 1:
+            from .utils.log import set_level
+            set_level(max(-1, cfg.verbosity))
         train_set.params = {**self.params, **train_set.params}
         train_set.construct()
         inner = train_set._inner
@@ -470,7 +473,7 @@ class Booster:
         train_metrics = create_metrics(self._metric_names, cfg,
                                        inner.metadata, inner.num_data)
         self.config = cfg
-        self._gbdt = GBDT()
+        self._gbdt = create_boosting(cfg.boosting_type())
         self._gbdt.init(cfg, inner, objective, train_metrics)
 
     def _init_from_string(self, model_str: str):

@@ -251,13 +251,21 @@ class Config:
     gpu_platform_id: int = -1
     gpu_device_id: int = -1
     gpu_use_dp: bool = False
-    # TPU-native additions: histogram accumulation dtype and device batch size
-    tpu_use_dp: bool = True          # fp32 (True) vs bf16 (False) hist accumulation
-    tpu_hist_chunk: int = 16384      # rows per on-device histogram chunk
+    # TPU-native additions: histogram accumulation dtype and device batch
+    # size. tpu_use_dp=true accumulates histograms at f32 grade via the
+    # bf16 hi/lo decomposition (wave cap 25); false = single bf16
+    # (2^-9 relative rounding on grad/hess, wave cap 32).
+    tpu_use_dp: bool = True
+    tpu_hist_chunk: int = 0          # rows per Pallas grid step; 0 = auto
     tpu_donate_buffers: bool = True
     # leaves split per device step (ops/wave_grower.py): one wave
     # histogram pass serves this many leaves at once. 1 = exact
-    # reference leaf-wise order; 0 = auto (Pallas kernel channel cap).
+    # reference leaf-wise order; 0 = auto: 24 with tpu_use_dp (hi/lo
+    # channel budget, kept a multiple of 8 for sublane alignment) or 32
+    # without — values above the active cap are clamped with a warning.
+    # NOTE: with W > 1 the grown tree can differ from the reference's
+    # strict leaf-wise order when the leaf budget binds mid-wave; set
+    # tpu_wave_size=1 for exact reference parity.
     tpu_wave_size: int = 0
     # iterations between host checks for the "no more splits" stop
     # (gbdt.cpp:393-409); device→host reads are high-latency, so the stop

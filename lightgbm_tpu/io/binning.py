@@ -80,22 +80,43 @@ def greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
 
         bin_cnt = 0
         lower_bounds[0] = float(distinct_values[0])
-        cur_cnt_inbin = 0
-        for i in range(num_distinct - 1):
-            if not is_big[i]:
-                rest_sample_cnt -= int(counts[i])
-            cur_cnt_inbin += int(counts[i])
-            if (is_big[i] or cur_cnt_inbin >= mean_bin_size or
-                    (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))):
+        if not is_big.any():
+            # fast path (the common continuous-feature case: every count
+            # below the mean): the greedy scan reduces to "next boundary
+            # = first prefix-sum >= base + mean", one searchsorted per
+            # bin instead of a python loop over every distinct value
+            csum = np.cumsum(np.asarray(counts, np.int64))
+            base = 0
+            while bin_cnt < max_bin - 1:
+                mean_bin_size = (rest_sample_cnt - base) \
+                    / max(rest_bin_cnt - bin_cnt, 1)
+                i = int(np.searchsorted(csum[:num_distinct - 1],
+                                        base + mean_bin_size, side="left"))
+                if i > num_distinct - 2:
+                    break
                 upper_bounds[bin_cnt] = float(distinct_values[i])
                 bin_cnt += 1
                 lower_bounds[bin_cnt] = float(distinct_values[i + 1])
-                if bin_cnt >= max_bin - 1:
-                    break
-                cur_cnt_inbin = 0
+                base = int(csum[i])
+        else:
+            cur_cnt_inbin = 0
+            for i in range(num_distinct - 1):
                 if not is_big[i]:
-                    rest_bin_cnt -= 1
-                    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+                    rest_sample_cnt -= int(counts[i])
+                cur_cnt_inbin += int(counts[i])
+                if (is_big[i] or cur_cnt_inbin >= mean_bin_size or
+                        (is_big[i + 1] and cur_cnt_inbin
+                         >= max(1.0, mean_bin_size * 0.5))):
+                    upper_bounds[bin_cnt] = float(distinct_values[i])
+                    bin_cnt += 1
+                    lower_bounds[bin_cnt] = float(distinct_values[i + 1])
+                    if bin_cnt >= max_bin - 1:
+                        break
+                    cur_cnt_inbin = 0
+                    if not is_big[i]:
+                        rest_bin_cnt -= 1
+                        mean_bin_size = rest_sample_cnt \
+                            / max(rest_bin_cnt, 1)
         bin_cnt += 1
         for i in range(bin_cnt - 1):
             val = _get_double_upper_bound((upper_bounds[i] + lower_bounds[i + 1]) / 2.0)
@@ -195,35 +216,41 @@ class BinMapper:
         self.default_bin = 0
         zero_cnt = int(total_sample_cnt - len(values) - na_cnt)
 
-        # distinct values with zero spliced at its sorted position
+        # distinct values with zero spliced at its sorted position —
+        # vectorized run-length grouping (the reference's sequential
+        # CheckDoubleEqualOrdered chaining maps exactly onto runs of
+        # consecutive ulp-near pairs; each run's representative is its
+        # LAST value, matching the loop's distinct_values[-1] = cur)
         values = np.sort(values)
-        distinct_values: List[float] = []
-        counts: List[int] = []
-        if len(values) == 0 or (values[0] > 0.0 and zero_cnt > 0):
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
-        if len(values) > 0:
-            distinct_values.append(float(values[0]))
-            counts.append(1)
-        for i in range(1, len(values)):
-            prev, cur = float(values[i - 1]), float(values[i])
-            if not _check_double_equal(prev, cur):
-                if prev < 0.0 and cur > 0.0:
-                    distinct_values.append(0.0)
-                    counts.append(zero_cnt)
-                distinct_values.append(cur)
-                counts.append(1)
+        if len(values):
+            near = np.nextafter(values[:-1], np.inf) >= values[1:]
+            starts = np.concatenate([[0], np.flatnonzero(~near) + 1])
+            ends = np.concatenate([starts[1:], [len(values)]])
+            dv = values[ends - 1].astype(np.float64)
+            cnts = (ends - starts).astype(np.int64)
+            # splice zero at its sorted position: the data is sorted, so
+            # the negative->positive crossing (prev < 0 < cur in the
+            # reference loop) happens at most once
+            if values[0] > 0.0 and zero_cnt > 0:
+                dv = np.insert(dv, 0, 0.0)
+                cnts = np.insert(cnts, 0, zero_cnt)
+            elif values[-1] < 0.0:
+                if zero_cnt > 0:
+                    dv = np.append(dv, 0.0)
+                    cnts = np.append(cnts, zero_cnt)
             else:
-                distinct_values[-1] = cur
-                counts[-1] += 1
-        if len(values) > 0 and values[-1] < 0.0 and zero_cnt > 0:
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
+                cross = np.flatnonzero((dv[:-1] < 0.0)
+                                       & (values[starts[1:]] > 0.0))
+                if len(cross):
+                    pos = cross[0] + 1
+                    dv = np.insert(dv, pos, 0.0)
+                    cnts = np.insert(cnts, pos, zero_cnt)
+        else:
+            dv = np.array([0.0])
+            cnts = np.array([zero_cnt], dtype=np.int64)
 
-        self.min_val = distinct_values[0]
-        self.max_val = distinct_values[-1]
-        dv = np.array(distinct_values)
-        cnts = np.array(counts, dtype=np.int64)
+        self.min_val = float(dv[0])
+        self.max_val = float(dv[-1])
 
         if bin_type == BinType.NUMERICAL:
             if self.missing_type == MissingType.ZERO:
@@ -265,12 +292,13 @@ class BinMapper:
         return int(np.searchsorted(bounds, value, side="left"))
 
     def _count_in_bins(self, dv, cnts, na_cnt) -> List[int]:
-        cnt_in_bin = [0] * self.num_bin
-        i_bin = 0
-        for v, c in zip(dv, cnts):
-            while v > self.bin_upper_bound[i_bin]:
-                i_bin += 1
-            cnt_in_bin[i_bin] += int(c)
+        """Vectorized: bin of each distinct value = first bound >= v."""
+        bounds = np.where(np.isnan(self.bin_upper_bound), np.inf,
+                          self.bin_upper_bound)
+        idx = np.searchsorted(bounds, dv, side="left")
+        cnt_in_bin = np.bincount(idx, weights=np.asarray(cnts, np.float64),
+                                 minlength=self.num_bin)
+        cnt_in_bin = cnt_in_bin.astype(np.int64).tolist()
         if self.missing_type == MissingType.NAN:
             cnt_in_bin[self.num_bin - 1] = na_cnt
         return cnt_in_bin

@@ -76,6 +76,10 @@ class GBDT:
         self.best_score: Dict = {}
         self.loaded_parameter = ""
         self._grower = None
+        # boosting-variant hooks (models/boosting.py): an in-jit
+        # gradient sampler (GOSS) and a per-iteration PRNG stream
+        self._sample_hook = None
+        self._hook_rng = None
 
     # -- init (gbdt.cpp:47-117) --------------------------------------------
 
@@ -126,6 +130,7 @@ class GBDT:
         self._zero_bias = jnp.zeros(self.num_tree_per_iteration,
                                     jnp.float32)
         self._dummy_gh = jnp.zeros((1, 1), jnp.float32)
+        self._dummy_key = jax.random.PRNGKey(0)
         self._fmask_cache = None
 
     def _setup_grower(self):
@@ -179,9 +184,19 @@ class GBDT:
         self._f_pad = f + self._pad_features
 
         # wave size: leaves split per device step (ops/wave_grower.py);
-        # 0 = auto (the Pallas kernel's hi/lo channel cap)
-        W = cfg.tpu_wave_size or 25
-        W = max(1, min(W, max(cfg.num_leaves, 2) - 1))
+        # 0 = auto. Capped by the Pallas channel budget AND kept a
+        # multiple of 8: weight blocks concatenate on the sublane axis,
+        # and misaligned 25-row pieces cost ~15x in relayout shuffles
+        # (measured 1.7s vs 83ms per tree at 1M rows). hi/lo f32-grade
+        # accumulation (tpu_use_dp) needs 5W <= 128 -> W = 24; single
+        # bf16 fused needs 4W <= 128 -> W = 32.
+        precision = "highest" if cfg.tpu_use_dp else "default"
+        w_cap = 24 if cfg.tpu_use_dp else 32
+        W = cfg.tpu_wave_size or w_cap
+        if W > w_cap:
+            log.warning("tpu_wave_size=%d exceeds the Pallas lane cap for "
+                        "this precision; clamping to %d", W, w_cap)
+        W = max(1, min(W, w_cap, max(cfg.num_leaves, 2) - 1))
         gcfg = WaveGrowerConfig(
             num_leaves=max(cfg.num_leaves, 2),
             # >= 2 so the per-feature split scan is never empty (the
@@ -189,8 +204,9 @@ class GBDT:
             num_bins=max(self.train_data.max_bin_global, 2),
             wave_size=W,
             max_depth=cfg.max_depth,
-            chunk=0,
-            hp=hp)
+            chunk=cfg.tpu_hist_chunk if cfg.tpu_hist_chunk > 0 else 0,
+            hp=hp,
+            precision=precision)
         self._grower_cfg = gcfg
         self._grower = make_grower_for_mode(
             mode, gcfg, meta, mesh, self._f_pad, cfg.top_k)
@@ -369,8 +385,10 @@ class GBDT:
             renew_w = None if w is None else jnp.asarray(w, jnp.float32)
             renew_alpha = float(obj.renew_tree_output_percentile())
 
+        sample_hook = self._sample_hook
+
         def step(scores, valid_scores, mask, fmask, shrink, init_bias,
-                 g_in, h_in):
+                 g_in, h_in, key):
             if custom:
                 g_all, h_all = g_in, h_in
             else:
@@ -378,6 +396,10 @@ class GBDT:
                     scores if K > 1 else scores[0])
                 if K == 1:
                     g_all, h_all = g_all[None, :], h_all[None, :]
+            if sample_hook is not None:
+                # in-jit gradient-based sampling (GOSS): may amplify
+                # g/h and shrink the bagging mask, all device-side
+                g_all, h_all, mask = sample_hook(g_all, h_all, mask, key)
             recs = []
             vs = list(valid_scores)
             for k in range(K):
@@ -471,9 +493,13 @@ class GBDT:
         init_bias = (jnp.asarray(init_scores, jnp.float32)
                      if first_iteration else self._zero_bias)
         step = self._get_step_fn(custom)
+        if self._sample_hook is not None:
+            key = jax.random.PRNGKey(self._hook_rng.integers(1, 2**31))
+        else:
+            key = self._dummy_key
         self._scores, new_valids, recs = step(
             self._scores, tuple(self._valid_scores), mask, fmask,
-            jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in)
+            jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in, key)
         self._valid_scores = list(new_valids)
         for k, rec in enumerate(recs):
             shrinkage_for_file = self.shrinkage_rate

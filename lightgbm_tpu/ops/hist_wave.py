@@ -46,6 +46,19 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _bf16_split(x):
+    """Split f32 into (hi, lo) with hi exactly bf16-representable and
+    hi + lo == x exactly. Bit-truncation of the low 16 mantissa bits —
+    NOT astype(bf16).astype(f32) (XLA's simplifier elides that convert
+    round-trip as identity, silently zeroing lo) and NOT
+    lax.reduce_precision (unimplemented in Pallas TPU lowering).
+    Truncation instead of round-to-nearest is fine: the decomposition
+    only needs hi to be exact under the MXU's bf16 input rounding."""
+    xi = jax.lax.bitcast_convert_type(x, jnp.int32)
+    hi = jax.lax.bitcast_convert_type(xi & jnp.int32(-65536), jnp.float32)
+    return hi, x - hi
+
+
 # ---------------------------------------------------------------------------
 # XLA reference implementation
 # ---------------------------------------------------------------------------
@@ -100,20 +113,24 @@ def wave_histogram_xla(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 # ---------------------------------------------------------------------------
 
 def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
-                      groups, group_sz, hilo):
+                      groups, group_sz, hilo, exact_dot=False):
     """One grid step = one row chunk; accumulates into out_ref (VMEM).
 
-    wl_ref:   [1, Wp] f32 wave leaf ids (-1 = inactive slot)
+    Every tensor keeps ROWS ON THE LANE AXIS — no relayouts anywhere:
+    the weight matrix is built transposed ([channels, Ct] on sublanes)
+    and the MXU dot contracts the lane axis of both operands.
+
+    wl_ref:   [Wp, 1] f32 wave leaf ids as a column (-1 = inactive)
     bins_ref: [Fp, Ct] feature-major bins (uint8)
-    ghl_ref:  [Ct, 4] f32 packed (grad, hess, leaf_id, 0)
+    ghl_ref:  [4, Ct] f32 packed rows (grad, hess, leaf_id, 0)
     out_ref:  [groups, gb_pad, 128] accumulated histograms
 
-    With ``hilo`` the weight columns carry bf16 hi/lo decompositions of
+    With ``hilo`` the weight rows carry bf16 hi/lo decompositions of
     grad and hess ([g_hi | g_lo | h_hi | h_lo | count] x W, needs
     5W <= 128): every product the bf16 MXU pass computes is then exact,
     and hi + lo restores ~16 mantissa bits — the reference's f32
     histogram accuracy (GPU-Performance.rst) at full bf16 MXU speed.
-    Without it the columns are [g | h | count] x W (3W <= 128) and
+    Without it the rows are [g | h | count] x W (3W <= 128) and
     grad/hess round to bf16 in the multiply.
     """
     step = pl.program_id(0)
@@ -122,28 +139,23 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    ghl = ghl_ref[...]
-    gvec = ghl[:, 0:1]                                  # [Ct, 1]
-    hvec = ghl[:, 1:2]
-    lvec = ghl[:, 2:3]
-    wl = wl_ref[0, :]                                   # [Wp]
-    m = (lvec == wl[None, :]) & (wl[None, :] >= 0.0)    # [Ct, Wp]
-    m = m.astype(jnp.float32)
-    mw = m[:, :W]
-    if hilo:
-        g_hi = gvec.astype(jnp.bfloat16).astype(jnp.float32)
-        g_lo = gvec - g_hi
-        h_hi = hvec.astype(jnp.bfloat16).astype(jnp.float32)
-        h_lo = hvec - h_hi
-        w_cols = jnp.concatenate(
-            [mw * g_hi, mw * g_lo, mw * h_hi, mw * h_lo, mw], axis=1)
+    gvec = ghl_ref[0:1, :]                              # [1, Ct]
+    hvec = ghl_ref[1:2, :]
+    lvec = ghl_ref[2:3, :]
+    wl = wl_ref[...]                                    # [Wp, 1]
+    mw = ((lvec == wl[:W]) & (wl[:W] >= 0.0)).astype(jnp.float32)
+    if hilo:                                            # mw: [W, Ct]
+        g_hi, g_lo = _bf16_split(gvec)
+        h_hi, h_lo = _bf16_split(hvec)
+        w_rows = jnp.concatenate(
+            [mw * g_hi, mw * g_lo, mw * h_hi, mw * h_lo, mw], axis=0)
     else:
-        w_cols = jnp.concatenate([mw * gvec, mw * hvec, mw], axis=1)
-    ncol = w_cols.shape[1]
-    if ncol != 128:
-        w_cols = jnp.pad(w_cols, ((0, 0), (0, 128 - ncol)))
+        w_rows = jnp.concatenate([mw * gvec, mw * hvec, mw], axis=0)
+    nrow = w_rows.shape[0]
+    if nrow != 128:
+        w_rows = jnp.pad(w_rows, ((0, 128 - nrow), (0, 0)))
 
-    ct = ghl.shape[0]
+    ct = gvec.shape[1]
     gb = group_sz * B
     # column vectors broadcastable against [gb, Ct]
     row_iota = jax.lax.broadcasted_iota(jnp.int32, (gb, 1), 0)
@@ -154,18 +166,22 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
         # stacked transposed one-hots of this group's features: row j is
         # (bins_t[p*group_sz + j//B, :] == j % B)
         sel = jnp.full((gb, ct), -1, jnp.int32)
-        for s in range(group_sz):
-            f = p * group_sz + s
+        for sidx in range(group_sz):
+            f = p * group_sz + sidx
             if f < F:
-                row = bins_ref[f, :].astype(jnp.int32)  # [Ct] lane vector
-                sel = jnp.where(which_feat == s, row[None, :], sel)
+                row = bins_ref[f, :].astype(jnp.int32)  # [Ct] lanes
+                sel = jnp.where(which_feat == sidx, row[None, :], sel)
         oh_t = (sel == which_bin).astype(jnp.float32)   # [gb, Ct]
-        # DEFAULT precision = one bf16 MXU pass; one-hot entries and the
-        # hi/lo weight columns are exactly bf16-representable, so the
-        # pass is exact and hi + lo restores f32-grade sums.
+        # contract the LANE axis of both operands: [gb, Ct] x [128, Ct]
+        # -> [gb, 128]. DEFAULT precision = one bf16 MXU pass; one-hot
+        # entries and the hi/lo rows are exactly bf16-representable, so
+        # the pass is exact and hi + lo restores f32-grade sums. In
+        # interpret mode (CPU tests) the XLA CPU "default" dot has
+        # different split-precision numerics, so force HIGHEST there.
         acc = jax.lax.dot_general(
-            oh_t, w_cols, dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.DEFAULT,
+            oh_t, w_rows, dimension_numbers=(((1,), (1,)), ((), ())),
+            precision=(jax.lax.Precision.HIGHEST if exact_dot
+                       else jax.lax.Precision.DEFAULT),
             preferred_element_type=jnp.float32)         # [gb, 128]
         gb_pad = out_ref.shape[1]
         if gb_pad != gb:
@@ -215,30 +231,34 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     ghl = jnp.stack([
         g.astype(jnp.float32), h.astype(jnp.float32),
         leaf_ids.astype(jnp.float32), jnp.zeros_like(g, jnp.float32)],
-        axis=1)                                          # [N, 4]
-    wl = wave_leaves.astype(jnp.float32)[None, :]        # [1, W]
-    wp = _round_up(W, 128)
+        axis=0)                                          # [4, N]
+    wp = _round_up(W, 8)
+    wl = wave_leaves.astype(jnp.float32)[:, None]        # [W, 1]
     if wp != W:
-        wl = jnp.pad(wl, ((0, 0), (0, wp - W)), constant_values=-1.0)
+        wl = jnp.pad(wl, ((0, wp - W), (0, 0)), constant_values=-1.0)
 
     kernel = functools.partial(
         _wave_hist_kernel, F=F, B=B, W=W, groups=groups,
-        group_sz=group_sz, hilo=hilo)
+        group_sz=group_sz, hilo=hilo, exact_dot=interpret)
 
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // chunk,),
         in_specs=[
-            pl.BlockSpec((1, wp), lambda i: (0, 0),
+            pl.BlockSpec((wp, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((F, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, 4), lambda i: (i, 0),
+            pl.BlockSpec((4, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((groups, gb_pad, 128), lambda i: (0, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((groups, gb_pad, 128), jnp.float32),
+        # the unrolled group loop's temporaries exceed the 16 MB default
+        # scoped-vmem cap; v5e has 128 MB physical VMEM
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(wl, bins_t, ghl)
 
@@ -277,29 +297,37 @@ TBL_PARENT, TBL_NEW, TBL_FEAT, TBL_BIN, TBL_DLEFT = 0, 1, 2, 3, 4
 TBL_MISS, TBL_DEFBIN, TBL_NUMBIN, TBL_SMALL = 5, 6, 7, 8
 TBL_ROWS = 16           # padded to an int32 sublane multiple
 
-FUSED_MAX_WAVE = 32     # 4 channels x W <= 128 MXU lanes
+FUSED_MAX_WAVE = 32          # 4 channels x W <= 128 MXU lanes (bf16 h)
+FUSED_MAX_WAVE_HILO = 24     # 5 channels, kept a multiple of 8
 
 
-def _fused_kernel(tbl_ref, binsf_ref, binsr_ref, ghm_ref, leaf_ref,
-                  hist_ref, leaf_out_ref, *, F, B, W, groups, group_sz):
+def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
+                  hist_ref, leaf_out_ref, *, F, B, W, groups, group_sz,
+                  hilo, exact_dot=False):
     """One grid step: partition one row chunk by the wave's W splits,
-    then accumulate the wave's smaller-child histograms.
+    then accumulate the wave's smaller-child histograms — ONE data pass.
 
-    tbl_ref:   [16, 128] i32 packed split table (TBL_* rows; col k =
-               wave slot k, -1 parent = inactive slot)
-    binsf_ref: [F, Ct]  feature-major bins (one-hot tiles)
-    binsr_ref: [Ct, F]  row-major bins (partition column extraction)
-    ghm_ref:   [Ct, 4]  f32 (grad, hess, bag_mask, 0); grad/hess are
+    Lane-natural layout throughout (rows on lanes): the partition runs
+    in [W, Ct] orientation fed by feature-major bin ROWS (no row-major
+    copy of the bins exists at all), per-slot split parameters are
+    columns of the transposed table, and the weight matrix is built
+    transposed for a lane-contracting MXU dot. No relayouts.
+
+    tbl_ref:   [128, 16] i32 packed split table (row k = wave slot k,
+               column j = TBL_* field j; parent -1 = inactive slot)
+    binsf_ref: [F, Ct]  feature-major bins (uint8)
+    ghm_ref:   [4, Ct]  f32 rows (grad, hess, bag_mask, 0); grad/hess
                pre-masked, the mask rides separately for the counts
-    leaf_ref:  [Ct, 1]  i32 leaf ids BEFORE this wave (all rows,
+    leaf_ref:  [1, Ct]  i32 leaf ids BEFORE this wave (all rows,
                out-of-bag included)
     hist_ref:  [groups, gb_pad, 128] accumulated histograms
-    leaf_out_ref: [Ct, 1] i32 leaf ids AFTER this wave
+    leaf_out_ref: [1, Ct] i32 leaf ids AFTER this wave
 
-    Channel layout (4W <= 128): [g_hi | g_lo | h | count] x W — grad in
-    exact bf16 hi/lo halves (see _wave_hist_kernel), hessian single
-    bf16 (strictly positive, so the 2^-9 rounding is relative-only and
-    cancels nowhere), count exact.
+    Channel layout: with ``hilo`` (tpu_use_dp) both grad and hess ride
+    exact bf16 hi/lo halves ([g_hi | g_lo | h_hi | h_lo | count] x W,
+    5W <= 128 -> W <= 24) — the documented f32-grade accumulation.
+    Without it: [g_hi | g_lo | h | count] x W (4W <= 128 -> W <= 32),
+    hessian single bf16 (2^-9 relative rounding). Counts exact always.
     """
     step = pl.program_id(0)
 
@@ -308,63 +336,77 @@ def _fused_kernel(tbl_ref, binsf_ref, binsr_ref, ghm_ref, leaf_ref,
         hist_ref[...] = jnp.zeros_like(hist_ref)
 
     i32 = jnp.int32
-    tbl = tbl_ref[...]
-    leaf = leaf_ref[...]                                # [Ct, 1]
-    ct = leaf.shape[0]
+    leaf = leaf_ref[...]                                # [1, Ct]
+    ct = leaf.shape[1]
+
+    # per-slot split parameters as [W, 1] columns
+    feat_c = tbl_ref[:W, TBL_FEAT:TBL_FEAT + 1]
+    bin_c = tbl_ref[:W, TBL_BIN:TBL_BIN + 1]
+    dleft_c = tbl_ref[:W, TBL_DLEFT:TBL_DLEFT + 1]
+    miss_c = tbl_ref[:W, TBL_MISS:TBL_MISS + 1]
+    defb_c = tbl_ref[:W, TBL_DEFBIN:TBL_DEFBIN + 1]
+    nb_c = tbl_ref[:W, TBL_NUMBIN:TBL_NUMBIN + 1]
+    parent_c = tbl_ref[:W, TBL_PARENT:TBL_PARENT + 1]
+    new_c = tbl_ref[:W, TBL_NEW:TBL_NEW + 1]
+    small_c = tbl_ref[:W, TBL_SMALL:TBL_SMALL + 1]
 
     # ---- partition (DataPartition::Split, data_partition.hpp:109) ----
-    feat_row = tbl[TBL_FEAT:TBL_FEAT + 1, :W]           # [1, W]
-    cols = jnp.zeros((ct, W), i32)
+    # cols[k, :] = bins of slot k's split feature: select among the
+    # feature ROWS (lane vectors) — no column extraction, no relayout
+    cols = jnp.zeros((W, ct), i32)
     for f in range(F):
-        cols = jnp.where(feat_row == f,
-                         binsr_ref[:, f:f + 1].astype(i32), cols)
-    bin_row = tbl[TBL_BIN:TBL_BIN + 1, :W]
-    dleft = tbl[TBL_DLEFT:TBL_DLEFT + 1, :W]
-    miss = tbl[TBL_MISS:TBL_MISS + 1, :W]
-    defb = tbl[TBL_DEFBIN:TBL_DEFBIN + 1, :W]
-    nb = tbl[TBL_NUMBIN:TBL_NUMBIN + 1, :W]
-    parent = tbl[TBL_PARENT:TBL_PARENT + 1, :W]
-    new_id = tbl[TBL_NEW:TBL_NEW + 1, :W]
-    # missing semantics match ops/partition.py row_goes_right
-    is_missing = (((miss == 2) & (cols == nb - 1))
-                  | ((miss == 1) & (cols == defb)))
-    right = jnp.where(is_missing, dleft == 0, cols > bin_row)
-    moved = (leaf == parent) & right & (parent >= 0)    # [Ct, W]
-    any_moved = jnp.any(moved, axis=1, keepdims=True)
-    dest = jnp.sum(jnp.where(moved, new_id, 0), axis=1, keepdims=True)
-    leaf_new = jnp.where(any_moved, dest, leaf)         # [Ct, 1]
+        cols = jnp.where(feat_c == f,
+                         binsf_ref[f, :].astype(i32)[None, :], cols)
+    # missing semantics match ops/partition.py row_goes_right; logical
+    # form, not jnp.where-on-bools (Mosaic can't lower the i8->i1
+    # truncation a boolean select produces)
+    is_missing = (((miss_c == 2) & (cols == nb_c - 1))
+                  | ((miss_c == 1) & (cols == defb_c)))
+    right = ((is_missing & (dleft_c == 0))
+             | (~is_missing & (cols > bin_c)))
+    moved = (leaf == parent_c) & right & (parent_c >= 0)    # [W, Ct]
+    any_moved = jnp.any(moved, axis=0, keepdims=True)       # [1, Ct]
+    dest = jnp.sum(jnp.where(moved, new_c, 0), axis=0,
+                   keepdims=True)
+    leaf_new = jnp.where(any_moved, dest, leaf)             # [1, Ct]
     leaf_out_ref[...] = leaf_new
 
-    # ---- wave weight columns ----
-    ghm = ghm_ref[...]
-    gvec = ghm[:, 0:1]
-    hvec = ghm[:, 1:2]
-    mvec = ghm[:, 2:3]
-    small = tbl[TBL_SMALL:TBL_SMALL + 1, :W]
-    m = ((leaf_new == small) & (small >= 0)).astype(jnp.float32)
-    g_hi = gvec.astype(jnp.bfloat16).astype(jnp.float32)
-    g_lo = gvec - g_hi
-    w_cols = jnp.concatenate(
-        [m * g_hi, m * g_lo, m * hvec, m * mvec], axis=1)   # [Ct, 4W]
-    if 4 * W != 128:
-        w_cols = jnp.pad(w_cols, ((0, 0), (0, 128 - 4 * W)))
+    # ---- transposed wave weight rows ----
+    gvec = ghm_ref[0:1, :]
+    hvec = ghm_ref[1:2, :]
+    mvec = ghm_ref[2:3, :]
+    m = ((leaf_new == small_c.astype(i32))
+         & (small_c >= 0)).astype(jnp.float32)              # [W, Ct]
+    g_hi, g_lo = _bf16_split(gvec)
+    if hilo:
+        h_hi, h_lo = _bf16_split(hvec)
+        w_rows = jnp.concatenate(
+            [m * g_hi, m * g_lo, m * h_hi, m * h_lo, m * mvec],
+            axis=0)                                          # [5W, Ct]
+    else:
+        w_rows = jnp.concatenate(
+            [m * g_hi, m * g_lo, m * hvec, m * mvec], axis=0)  # [4W, Ct]
+    nrow = w_rows.shape[0]
+    if nrow != 128:
+        w_rows = jnp.pad(w_rows, ((0, 128 - nrow), (0, 0)))
 
-    # ---- one-hot tiles + MXU accumulate (see _wave_hist_kernel) ----
+    # ---- one-hot tiles + lane-contracting MXU accumulate ----
     gb = group_sz * B
     row_iota = jax.lax.broadcasted_iota(i32, (gb, 1), 0)
     which_feat = row_iota // B
     which_bin = row_iota % B
     for p in range(groups):
         sel = jnp.full((gb, ct), -1, i32)
-        for s in range(group_sz):
-            f = p * group_sz + s
+        for sidx in range(group_sz):
+            f = p * group_sz + sidx
             if f < F:
                 row = binsf_ref[f, :].astype(i32)
-                sel = jnp.where(which_feat == s, row[None, :], sel)
+                sel = jnp.where(which_feat == sidx, row[None, :], sel)
         oh_t = (sel == which_bin).astype(jnp.float32)
         acc = jax.lax.dot_general(
-            oh_t, w_cols, dimension_numbers=(((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.DEFAULT,
+            oh_t, w_rows, dimension_numbers=(((1,), (1,)), ((), ())),
+            precision=(jax.lax.Precision.HIGHEST if exact_dot
+                       else jax.lax.Precision.DEFAULT),
             preferred_element_type=jnp.float32)
         gb_pad = hist_ref.shape[1]
         if gb_pad != gb:
@@ -372,21 +414,27 @@ def _fused_kernel(tbl_ref, binsf_ref, binsr_ref, ghm_ref, leaf_ref,
         hist_ref[p, :, :] += acc
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "chunk"))
-def fused_partition_histogram_pallas(bins_t, bins_r, g, h, sample_mask,
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk",
+                                             "interpret", "precision"))
+def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
                                      leaf_ids, tbl, *, num_bins,
-                                     chunk=2048):
+                                     chunk=2048, interpret=False,
+                                     precision="highest"):
     """Partition one wave + build its smaller-child histograms in ONE
     data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]).
 
-    tbl: [16, W] int32 packed split table (TBL_* rows). g/h must be
-    pre-masked by sample_mask; counts use the mask channel.
+    tbl: [9, W] int32 packed split table (TBL_* rows). g/h must be
+    pre-masked by sample_mask; counts use the mask channel. Only the
+    feature-major bins are read — the partition selects feature rows.
     """
     F, n = bins_t.shape
     W = int(tbl.shape[1])
     B = num_bins
-    if W > FUSED_MAX_WAVE:
-        raise NotImplementedError(f"fused wave needs W <= {FUSED_MAX_WAVE}")
+    hilo = precision != "default"
+    cap = FUSED_MAX_WAVE_HILO if hilo else FUSED_MAX_WAVE
+    if W > cap:
+        raise NotImplementedError(f"fused wave needs W <= {cap}")
+    nchan = 5 if hilo else 4
     group_sz = max(1, 128 // B)
     gb = group_sz * B
     groups = -(-F // group_sz)
@@ -395,7 +443,6 @@ def fused_partition_histogram_pallas(bins_t, bins_r, g, h, sample_mask,
     pad = (-n) % chunk
     if pad:
         bins_t = jnp.pad(bins_t, ((0, 0), (0, pad)))
-        bins_r = jnp.pad(bins_r, ((0, pad), (0, 0)))
         g = jnp.pad(g, (0, pad))
         h = jnp.pad(h, (0, pad))
         sample_mask = jnp.pad(sample_mask, (0, pad))
@@ -405,46 +452,56 @@ def fused_partition_histogram_pallas(bins_t, bins_r, g, h, sample_mask,
     ghm = jnp.stack([
         g.astype(jnp.float32), h.astype(jnp.float32),
         sample_mask.astype(jnp.float32),
-        jnp.zeros_like(g, jnp.float32)], axis=1)          # [N, 4]
-    leaf2d = leaf_ids.astype(jnp.int32)[:, None]          # [N, 1]
-    tbl16 = jnp.pad(tbl.astype(jnp.int32),
-                    ((0, TBL_ROWS - tbl.shape[0]), (0, 128 - W)),
-                    constant_values=-1)                    # [16, 128]
+        jnp.zeros_like(g, jnp.float32)], axis=0)          # [4, N]
+    leaf2d = leaf_ids.astype(jnp.int32)[None, :]          # [1, N]
+    # transposed table: row k = slot k, col j = field j
+    tblT = jnp.pad(tbl.astype(jnp.int32).T,
+                   ((0, 128 - W), (0, TBL_ROWS - tbl.shape[0])),
+                   constant_values=-1)                     # [128, 16]
 
     kernel = functools.partial(
-        _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz)
+        _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz,
+        hilo=hilo, exact_dot=interpret)
 
     hist, leaf_out = pl.pallas_call(
         kernel,
         grid=(n_pad // chunk,),
         in_specs=[
-            pl.BlockSpec((TBL_ROWS, 128), lambda i: (0, 0),
+            pl.BlockSpec((128, TBL_ROWS), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((F, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, F), lambda i: (i, 0),
+            pl.BlockSpec((4, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, 4), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, 1), lambda i: (i, 0),
+            pl.BlockSpec((1, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
             pl.BlockSpec((groups, gb_pad, 128), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((chunk, 1), lambda i: (i, 0),
+            pl.BlockSpec((1, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((groups, gb_pad, 128), jnp.float32),
-            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
         ),
-    )(tbl16, bins_t, bins_r, ghm, leaf2d)
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(tblT, bins_t, ghm, leaf2d)
 
-    # [groups, gb_pad, 128] -> [F, B, 4W] -> [W, F, B, 3]
-    hist = hist[:, :gb, :4 * W].reshape(groups * group_sz, B, 4 * W)[:F]
-    hist = hist.reshape(F, B, 4, W)
-    hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi + lo
-                      hist[:, :, 2],                   # h
-                      hist[:, :, 3]], axis=2)          # count
-    return leaf_out[:n, 0], hist.transpose(3, 0, 1, 2)
+    # [groups, gb_pad, 128] -> [F, B, nchan*W] -> [W, F, B, 3].
+    # channel rows were [c*W + k]: reshape (nchan, W) then combine
+    hist = hist[:, :gb, :nchan * W].reshape(
+        groups * group_sz, B, nchan * W)[:F]
+    hist = hist.reshape(F, B, nchan, W)
+    if hilo:
+        hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo
+                          hist[:, :, 2] + hist[:, :, 3],   # h = hi+lo
+                          hist[:, :, 4]], axis=2)          # count
+    else:
+        hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo
+                          hist[:, :, 2],                   # h (bf16)
+                          hist[:, :, 3]], axis=2)          # count
+    return leaf_out[0, :n], hist.transpose(3, 0, 1, 2)

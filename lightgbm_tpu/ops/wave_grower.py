@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .grower import TreeRecord
-from .hist_wave import wave_histogram
+from .hist_wave import (fused_partition_histogram_pallas, wave_histogram)
 from .partition import row_goes_right
 from .split import (FeatureMeta, SplitParams, SplitResult, KMIN_SCORE,
                     calculate_leaf_output, find_best_split)
@@ -53,6 +53,15 @@ class WaveGrowerConfig(NamedTuple):
     chunk: int = 0         # rows per kernel step (0 = impl default)
     hp: SplitParams = SplitParams()
     use_pallas: bool | None = None   # None = auto by backend
+    # histogram accumulation: "highest" = bf16 hi/lo exact-product
+    # decomposition (f32-grade sums, W <= 25), "default" = single bf16
+    # (W <= 42/32). Plumbed from config.tpu_use_dp.
+    precision: str = "highest"
+    # fused partition+histogram kernel (ONE data pass per wave instead
+    # of W partition passes + a histogram pass). None = auto: on
+    # whenever the Pallas path is on and W fits; interpret mode is used
+    # off-TPU so tests exercise the same code path.
+    fused: bool | None = None
 
 
 class _State(NamedTuple):
@@ -81,6 +90,14 @@ class _State(NamedTuple):
     n_splits: jax.Array        # scalar int32 (= num_leaves - 1)
     go_on: jax.Array           # scalar bool
     rec: TreeRecord
+
+
+def _pallas_on(use_pallas: bool | None) -> bool:
+    """Resolve the use_pallas tri-state the same way wave_histogram does."""
+    if use_pallas is None:
+        from ..utils.device import on_tpu
+        return on_tpu()
+    return use_pallas
 
 
 def _store_batch(table, idx, vals, active):
@@ -121,11 +138,26 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     hp = cfg.hp
     meta = FeatureMeta(*[jnp.asarray(x) for x in meta])
 
+    # fused partition+histogram path (serial mode only: the parallel
+    # learners inject their own hist/partition seams)
+    default_seams = (hist_fn is None and partition_fn is None)
+    use_fused = cfg.fused
+    if use_fused is None:
+        from .hist_wave import FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO
+        fused_cap = (FUSED_MAX_WAVE_HILO if cfg.precision != "default"
+                     else FUSED_MAX_WAVE)
+        use_fused = (default_seams and W <= fused_cap
+                     and _pallas_on(cfg.use_pallas))
+    if use_fused:
+        from ..utils.device import on_tpu
+        fused_interpret = not on_tpu()
+
     if hist_fn is None:
         def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
             return wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
                                   num_bins=B, chunk=cfg.chunk,
-                                  use_pallas=cfg.use_pallas)
+                                  use_pallas=cfg.use_pallas,
+                                  precision=cfg.precision)
 
     if split_fn is None:
         def split_fn(hists, sg, sh, nd, fmask, can):
@@ -256,17 +288,35 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             rg, rh = state.t_right_sum_g[wl], state.t_right_sum_h[wl]
             lo, ro = state.t_left_output[wl], state.t_right_output[wl]
 
-            # 3. partition: apply all wave splits in one pass
-            leaf_ids = partition_fn(bins_t, state.leaf_ids, wl, new_ids,
-                                    feat, tbin, dleft, active)
-
-            # 4. smaller-child histograms in ONE wave pass; siblings by
-            #    subtraction from the pooled parent histogram
+            # 3+4. partition, then smaller-child histograms; siblings by
+            # subtraction from the pooled parent histogram. The fused
+            # Pallas path does both in ONE data pass (ocl/histogram256's
+            # partition-then-accumulate per workgroup, without the W
+            # separate partition passes).
             left_smaller = lcnt <= rcnt
             small_ids = jnp.where(left_smaller, wl, new_ids)
             small_ids = jnp.where(active, small_ids, -1)
-            hist_small = hist_fn(bins_t, grad, hess,
-                                 bag_mask_ids(leaf_ids), small_ids)
+            if use_fused:
+                safe_feat = jnp.maximum(feat, 0)
+                tbl = jnp.stack([
+                    wl, new_ids, safe_feat, tbin,
+                    dleft.astype(jnp.int32),
+                    meta.missing_type[safe_feat],
+                    meta.default_bin[safe_feat],
+                    meta.num_bin[safe_feat], small_ids])
+                leaf_ids, hist_small = fused_partition_histogram_pallas(
+                    bins_t, grad, hess, sample_mask,
+                    state.leaf_ids, tbl, num_bins=B,
+                    chunk=cfg.chunk or 2048, interpret=fused_interpret,
+                    precision=cfg.precision)
+                # out-of-bag rows partition too; their g/h are pre-masked
+                # and the count channel rides on sample_mask
+            else:
+                leaf_ids = partition_fn(bins_t, state.leaf_ids, wl,
+                                        new_ids, feat, tbin, dleft,
+                                        active)
+                hist_small = hist_fn(bins_t, grad, hess,
+                                     bag_mask_ids(leaf_ids), small_ids)
             parent_hist = state.hist[wl]                 # [W, F, B, 3]
             hist_large = parent_hist - hist_small
             ls4 = left_smaller[:, None, None, None]

@@ -82,7 +82,8 @@ def _hist(cfg: WaveGrowerConfig):
     def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
         return wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
                               num_bins=cfg.num_bins, chunk=cfg.chunk,
-                              use_pallas=cfg.use_pallas)
+                              use_pallas=cfg.use_pallas,
+                              precision=cfg.precision)
     return hist_fn
 
 

@@ -1,0 +1,216 @@
+"""Wave histogram / fused kernel / wave grower regression tests.
+
+Promotes the round-2 scratch parity checks into the collected suite
+(VERDICT r2 weak #5) and adds coverage for the fused partition+histogram
+kernel (hist_wave.py) now wired into the grower. The Pallas kernels run
+in interpret mode on the CPU test backend — same code path as TPU, with
+HIGHEST-precision dots standing in for the MXU's exact bf16 products.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.grower import GrowerConfig, make_tree_grower
+from lightgbm_tpu.ops.hist_wave import (fused_partition_histogram_pallas,
+                                        wave_histogram_pallas,
+                                        wave_histogram_xla)
+from lightgbm_tpu.ops.split import FeatureMeta, SplitParams
+from lightgbm_tpu.ops.wave_grower import (WaveGrowerConfig,
+                                          apply_wave_splits,
+                                          make_wave_grower)
+
+
+def _numpy_hist(bins_t, g, h, leaf, wl, B):
+    """Per-slot histogram oracle (plain loops)."""
+    W, F = len(wl), bins_t.shape[0]
+    out = np.zeros((W, F, B, 3), np.float64)
+    for k, l in enumerate(wl):
+        if l < 0:
+            continue
+        m = leaf == l
+        for f in range(F):
+            out[k, f, :, 0] = np.bincount(
+                bins_t[f, m], weights=g[m], minlength=B)[:B]
+            out[k, f, :, 1] = np.bincount(
+                bins_t[f, m], weights=h[m], minlength=B)[:B]
+            out[k, f, :, 2] = np.bincount(bins_t[f, m], minlength=B)[:B]
+    return out
+
+
+def _problem(N=777, F=6, B=63, n_leaves=5, seed=3):
+    r = np.random.default_rng(seed)
+    bins_t = r.integers(0, B, (F, N)).astype(np.uint8)
+    g = r.normal(size=N).astype(np.float32)
+    h = r.uniform(0.2, 1.0, N).astype(np.float32)
+    leaf = r.integers(-1, n_leaves, N).astype(np.int32)
+    return bins_t, g, h, leaf
+
+
+class TestWaveHistogram:
+    def test_xla_matches_numpy_oracle(self):
+        bins_t, g, h, leaf = _problem()
+        wl = np.array([0, 2, -1, 4, 1], np.int32)
+        out = np.asarray(wave_histogram_xla(
+            jnp.asarray(bins_t), jnp.asarray(g), jnp.asarray(h),
+            jnp.asarray(leaf), jnp.asarray(wl), num_bins=64))
+        ref = _numpy_hist(bins_t, g, h, leaf, wl, 64)
+        np.testing.assert_allclose(out, ref, atol=2e-4)
+        np.testing.assert_array_equal(out[..., 2], ref[..., 2])
+
+    @pytest.mark.parametrize("precision", ["highest", "default"])
+    def test_pallas_matches_xla(self, precision):
+        bins_t, g, h, leaf = _problem()
+        wl = np.array([0, 2, -1, 4, 1], np.int32)
+        args = (jnp.asarray(bins_t), jnp.asarray(g), jnp.asarray(h),
+                jnp.asarray(leaf), jnp.asarray(wl))
+        ref = np.asarray(wave_histogram_xla(*args, num_bins=64))
+        out = np.asarray(wave_histogram_pallas(
+            *args, num_bins=64, chunk=256, interpret=True,
+            precision=precision))
+        np.testing.assert_array_equal(out[..., 2], ref[..., 2])
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+class TestFusedKernel:
+    def test_fused_matches_unfused(self):
+        """Partition bit-exact, histograms f32-grade vs the unfused
+        (apply_wave_splits + wave_histogram_xla) pipeline."""
+        r = np.random.default_rng(0)
+        N, F, B, W = 999, 5, 64, 8
+        bins_t = r.integers(0, 63, (F, N)).astype(np.uint8)
+        g = r.normal(size=N).astype(np.float32)
+        h = r.uniform(0.1, 1, N).astype(np.float32)
+        mask = (r.uniform(size=N) > 0.3).astype(np.float32)
+        leaf = r.integers(0, 4, N).astype(np.int32)
+        meta_np = FeatureMeta(
+            num_bin=np.full(F, 64, np.int32),
+            missing_type=np.array([0, 1, 2, 0, 1], np.int32),
+            default_bin=np.array([0, 3, 0, 0, 5], np.int32),
+            monotone=np.zeros(F, np.int32),
+            penalty=np.ones(F, np.float32))
+        meta = FeatureMeta(*[jnp.asarray(x) for x in meta_np])
+        wl = np.array([0, 1, 2, 3, -1, -1, -1, -1], np.int32)
+        new_ids = np.array([4, 5, 6, 7, -1, -1, -1, -1], np.int32)
+        feat = r.integers(0, F, W).astype(np.int32)
+        tbin = r.integers(0, 60, W).astype(np.int32)
+        dleft = r.integers(0, 2, W).astype(bool)
+        small = new_ids.copy()
+
+        gm, hm = g * mask, h * mask
+        tbl = jnp.stack([jnp.asarray(x) for x in [
+            wl, new_ids, feat, tbin, dleft.astype(np.int32),
+            meta_np.missing_type[feat], meta_np.default_bin[feat],
+            meta_np.num_bin[feat], small]])
+        leaf_f, hist_f = fused_partition_histogram_pallas(
+            jnp.asarray(bins_t), jnp.asarray(gm),
+            jnp.asarray(hm), jnp.asarray(mask), jnp.asarray(leaf), tbl,
+            num_bins=B, chunk=256, interpret=True)
+
+        leaf_u = apply_wave_splits(
+            jnp.asarray(bins_t), jnp.asarray(leaf), jnp.asarray(wl),
+            jnp.asarray(new_ids), jnp.asarray(feat), jnp.asarray(tbin),
+            jnp.asarray(dleft), jnp.asarray(wl >= 0), meta)
+        bag_leaf = jnp.where(jnp.asarray(mask) > 0, leaf_u, -1)
+        hist_u = wave_histogram_xla(
+            jnp.asarray(bins_t), jnp.asarray(gm), jnp.asarray(hm),
+            bag_leaf, jnp.asarray(small), num_bins=B)
+
+        np.testing.assert_array_equal(np.asarray(leaf_f),
+                                      np.asarray(leaf_u))
+        hf, hu = np.asarray(hist_f), np.asarray(hist_u)
+        np.testing.assert_array_equal(hf[..., 2], hu[..., 2])
+        np.testing.assert_allclose(hf, hu, atol=5e-5)
+
+
+def _grower_problem():
+    r = np.random.default_rng(0)
+    N, F, B = 3000, 8, 63
+    bins = r.integers(0, B, (N, F)).astype(np.uint8)
+    logit = (bins[:, 0].astype(float) / B - 0.5
+             + 0.3 * (bins[:, 1] > 30) - 0.2 * (bins[:, 2] < 10))
+    y = (logit + 0.3 * r.normal(size=N) > 0).astype(np.float32)
+    grad = jnp.asarray(0.5 - y)
+    hess = jnp.full(N, 0.25, jnp.float32)
+    mask = jnp.asarray((r.random(N) < 0.8).astype(np.float32))
+    fmask = jnp.ones(F, bool)
+    meta = FeatureMeta(
+        num_bin=np.full(F, B, np.int32),
+        missing_type=np.zeros(F, np.int32),
+        default_bin=np.zeros(F, np.int32),
+        monotone=np.zeros(F, np.int32),
+        penalty=np.ones(F, np.float32))
+    return bins, grad, hess, mask, fmask, meta, B
+
+
+class TestWaveGrower:
+    def test_wave1_matches_legacy_grower(self):
+        """W=1 reproduces the round-1 strict leaf-wise grower exactly
+        (the correctness oracle relationship from scratch/, promoted)."""
+        bins, grad, hess, mask, fmask, meta, B = _grower_problem()
+        L = 31
+        hp = SplitParams(min_data_in_leaf=20)
+        old = make_tree_grower(
+            GrowerConfig(num_leaves=L, num_bins=B, chunk=bins.shape[0],
+                         hp=hp), meta)
+        rec_o, leaf_o = old(jnp.asarray(bins), grad, hess, mask, fmask)
+        new = make_wave_grower(
+            WaveGrowerConfig(num_leaves=L, num_bins=B, wave_size=1,
+                             hp=hp), meta)
+        rec_n, leaf_n = new(jnp.asarray(bins.T.copy()), grad, hess,
+                            mask, fmask)
+        assert int(rec_o.num_leaves) == int(rec_n.num_leaves)
+        np.testing.assert_array_equal(np.asarray(rec_o.split_feature),
+                                      np.asarray(rec_n.split_feature))
+        np.testing.assert_array_equal(np.asarray(rec_o.split_bin),
+                                      np.asarray(rec_n.split_bin))
+        np.testing.assert_array_equal(np.asarray(leaf_o),
+                                      np.asarray(leaf_n))
+        np.testing.assert_allclose(np.asarray(rec_o.leaf_output),
+                                   np.asarray(rec_n.leaf_output),
+                                   atol=1e-5)
+
+    def test_wave_batched_quality(self):
+        """W>1 trees reach the same total gain grade as W=1 (waves split
+        in gain order; only budget-boundary choices may differ)."""
+        bins, grad, hess, mask, fmask, meta, B = _grower_problem()
+        L = 31
+        hp = SplitParams(min_data_in_leaf=20)
+        gains = {}
+        for W in (1, 8):
+            gr = make_wave_grower(
+                WaveGrowerConfig(num_leaves=L, num_bins=B, wave_size=W,
+                                 hp=hp), meta)
+            rec, _ = gr(jnp.asarray(bins.T.copy()), grad, hess, mask,
+                        fmask)
+            gains[W] = float(np.asarray(rec.split_gain).sum())
+            assert int(rec.num_leaves) == L
+        assert gains[8] >= 0.95 * gains[1]
+
+    def test_fused_grower_matches_unfused(self):
+        """The fused Pallas grower path (interpret mode) grows the same
+        tree as the unfused path."""
+        bins, grad, hess, mask, fmask, meta, B = _grower_problem()
+        L = 15
+        hp = SplitParams(min_data_in_leaf=20)
+        base = make_wave_grower(
+            WaveGrowerConfig(num_leaves=L, num_bins=B, wave_size=8,
+                             hp=hp, fused=False), meta)
+        rec_b, leaf_b = base(jnp.asarray(bins.T.copy()), grad, hess,
+                             mask, fmask)
+        fused = make_wave_grower(
+            WaveGrowerConfig(num_leaves=L, num_bins=B, wave_size=8,
+                             hp=hp, fused=True, chunk=1024), meta)
+        rec_f, leaf_f = fused(jnp.asarray(bins.T.copy()), grad, hess,
+                              mask, fmask)
+        assert int(rec_b.num_leaves) == int(rec_f.num_leaves)
+        np.testing.assert_array_equal(np.asarray(rec_b.split_feature),
+                                      np.asarray(rec_f.split_feature))
+        np.testing.assert_array_equal(np.asarray(rec_b.split_bin),
+                                      np.asarray(rec_f.split_bin))
+        np.testing.assert_array_equal(np.asarray(leaf_b),
+                                      np.asarray(leaf_f))
+        np.testing.assert_allclose(np.asarray(rec_f.leaf_output),
+                                   np.asarray(rec_b.leaf_output),
+                                   atol=1e-4)
