@@ -41,7 +41,7 @@ def make_higgs_like(n_rows: int, n_features: int = 28, seed: int = 7):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--rows", type=int, default=11_000_000)
     ap.add_argument("--iters", type=int, default=500)
     ap.add_argument("--leaves", type=int, default=255)
     ap.add_argument("--max-bin", type=int, default=63)

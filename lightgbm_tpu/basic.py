@@ -373,8 +373,13 @@ class Dataset:
         return self
 
     def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if categorical_feature == "auto":
+            # 'auto' means "keep what the Dataset already has"
+            # (reference basic.py:1040-1053)
+            return self
         if self._inner is not None and \
-                categorical_feature != self.categorical_feature:
+                list(categorical_feature) != list(
+                    self.categorical_feature or []):
             raise LightGBMError("Cannot change categorical_feature after "
                                 "the dataset was constructed")
         self.categorical_feature = categorical_feature

@@ -1,0 +1,405 @@
+"""C-API-shaped entry points.
+
+TPU-native counterpart of the reference C API (reference:
+src/c_api.cpp:47-1568, include/LightGBM/c_api.h). The reference exports
+a C ABI because its engine is C++; here the engine is in-process
+JAX/Python, so the same surface is exposed as Python functions with the
+LGBM_* names and c_api semantics: handles are opaque objects, datasets
+are constructed raw-then-finished-by-first-booster, boosters train one
+iteration at a time. Out-parameters become return values; everything
+else (dtype tags, predict tags, field names, parameter strings) matches
+c_api.h so ports of C callers (e.g. the fork's cache-admission driver,
+src/test.cpp) transliterate line by line.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import _DEFAULT_METRIC, _resolve_metric_names
+from .config import Config, param_dict_to_str
+from .io.dataset import Metadata, TpuDataset
+from .metrics import create_metrics
+from .models.boosting import create_boosting
+from .objectives import create_objective
+from .utils import log
+from .utils.log import LightGBMError
+
+# dtype tags (c_api.h:20-27)
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+
+# predict tags (c_api.h:29-35)
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+
+def _params_to_config(parameters) -> Config:
+    cfg = Config()
+    if isinstance(parameters, str):
+        cfg.set(Config.str2map(parameters))
+    elif isinstance(parameters, dict):
+        cfg.set({k: str(v) for k, v in parameters.items()})
+    elif parameters:
+        raise LightGBMError("parameters must be a dict or 'k=v' string")
+    return cfg
+
+
+class _DatasetHandle:
+    """Raw matrix + metadata; binning happens when the first booster
+    (or reference link) construction needs it (c_api.cpp Dataset
+    creation is likewise deferred to ConstructFromSampleData)."""
+
+    def __init__(self, X: np.ndarray, cfg: Config,
+                 reference: Optional["_DatasetHandle"] = None):
+        self.X = np.asarray(X, np.float64)
+        self.cfg = cfg
+        self.reference = reference
+        self.fields: Dict[str, np.ndarray] = {}
+        self._inner: Optional[TpuDataset] = None
+
+    def construct(self) -> TpuDataset:
+        if self._inner is None:
+            meta = Metadata(
+                label=self.fields.get("label"),
+                weight=self.fields.get("weight"),
+                group=self.fields.get("group"),
+                init_score=self.fields.get("init_score"))
+            cats = _parse_cat_spec(self.cfg)
+            if self.reference is not None:
+                self._inner = self.reference.construct() \
+                    .create_valid(self.X, meta)
+            else:
+                ds = TpuDataset(self.cfg)
+                ds.construct_from_matrix(self.X, meta, categorical=cats)
+                self._inner = ds
+        return self._inner
+
+
+def _parse_cat_spec(cfg: Config) -> List[int]:
+    spec = cfg.categorical_feature
+    if not spec:
+        return []
+    return [int(x) for x in str(spec).split(",") if x.strip()]
+
+
+def _csr_to_dense(indptr, indices, data, num_col: int) -> np.ndarray:
+    indptr = np.asarray(indptr, np.int64)
+    indices = np.asarray(indices, np.int64)
+    data = np.asarray(data, np.float64)
+    n = len(indptr) - 1
+    X = np.zeros((n, num_col), np.float64)
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    X[rows, indices[:len(rows)]] = data[:len(rows)]
+    return X
+
+
+# ---------------------------------------------------------------------------
+# Dataset API (c_api.cpp:215-505)
+# ---------------------------------------------------------------------------
+
+def _mat_to_2d(data, nrow, ncol, is_row_major) -> np.ndarray:
+    X = np.asarray(data, np.float64)
+    if X.ndim == 1:
+        # flat buffers honor is_row_major like the C API (c_api.cpp
+        # RowFunctionFromDenseMatric); 2-D numpy inputs already carry
+        # their own layout
+        X = X.reshape(int(nrow), int(ncol)) if is_row_major \
+            else X.reshape(int(ncol), int(nrow)).T
+    return X
+
+
+def LGBM_DatasetCreateFromMat(data, data_type=C_API_DTYPE_FLOAT64,
+                              nrow=None, ncol=None, is_row_major=1,
+                              parameters="", reference=None):
+    """c_api.cpp:345 LGBM_DatasetCreateFromMat."""
+    X = _mat_to_2d(data, nrow, ncol, is_row_major)
+    return _DatasetHandle(X, _params_to_config(parameters), reference)
+
+
+def LGBM_DatasetCreateFromCSR(indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col,
+                              parameters="", reference=None):
+    """c_api.cpp:268 LGBM_DatasetCreateFromCSR (densified: the engine's
+    HBM layout is dense by design, io/dataset.py)."""
+    X = _csr_to_dense(indptr, indices, data, int(num_col))
+    return _DatasetHandle(X, _params_to_config(parameters), reference)
+
+
+def LGBM_DatasetCreateFromFile(filename: str, parameters="",
+                               reference=None):
+    """c_api.cpp:215."""
+    from .io.loader import DatasetLoader
+    cfg = _params_to_config(parameters)
+    h = _DatasetHandle(np.zeros((0, 0)), cfg,
+                       reference)
+    loader = DatasetLoader(cfg)
+    h._inner = loader.load_from_file(
+        filename,
+        reference=reference.construct() if reference else None)
+    return h
+
+
+def LGBM_DatasetSetField(handle: _DatasetHandle, field_name: str,
+                         field_data, num_element=None,
+                         dtype=C_API_DTYPE_FLOAT32):
+    """c_api.cpp:436."""
+    arr = np.asarray(field_data)
+    handle.fields[field_name] = arr
+    if handle._inner is not None:
+        md = handle._inner.metadata
+        if field_name == "label":
+            md.label = arr.astype(np.float32).reshape(-1)
+        elif field_name == "weight":
+            md.weights = arr.astype(np.float32).reshape(-1)
+        elif field_name == "init_score":
+            md.init_score = arr.astype(np.float64)
+        elif field_name == "group":
+            g = arr.astype(np.int64).reshape(-1)
+            md.query_boundaries = np.concatenate(
+                [[0], np.cumsum(g)]).astype(np.int64)
+        else:
+            raise LightGBMError(f"Unknown field {field_name!r}")
+    return 0
+
+
+def LGBM_DatasetGetField(handle: _DatasetHandle, field_name: str):
+    """c_api.cpp:459 — returns the array (out-params -> return)."""
+    if handle._inner is not None:
+        md = handle._inner.metadata
+        got = {"label": md.label, "weight": md.weights,
+               "init_score": md.init_score}.get(field_name)
+        if got is not None:
+            return got
+    return handle.fields.get(field_name)
+
+
+def LGBM_DatasetGetNumData(handle: _DatasetHandle) -> int:
+    return (handle._inner.num_data if handle._inner is not None
+            else handle.X.shape[0])
+
+
+def LGBM_DatasetGetNumFeature(handle: _DatasetHandle) -> int:
+    return (handle._inner.num_total_features
+            if handle._inner is not None else handle.X.shape[1])
+
+
+def LGBM_DatasetSaveBinary(handle: _DatasetHandle, filename: str):
+    handle.construct().save_binary(filename)
+    return 0
+
+
+def LGBM_DatasetFree(handle: _DatasetHandle):
+    handle._inner = None
+    handle.X = None
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Booster API (c_api.cpp:506-1200)
+# ---------------------------------------------------------------------------
+
+class _BoosterHandle:
+    def __init__(self, gbdt, cfg: Config, train: Optional[_DatasetHandle]):
+        self.gbdt = gbdt
+        self.cfg = cfg
+        self.train = train
+
+
+def LGBM_BoosterCreate(train_data: _DatasetHandle, parameters="",
+                       out=None) -> _BoosterHandle:
+    """c_api.cpp:506."""
+    cfg = _params_to_config(parameters)
+    inner = train_data.construct()
+    objective = create_objective(cfg.objective, cfg)
+    if objective is not None:
+        objective.init(inner.metadata, inner.num_data)
+    metric_names = _resolve_metric_names(cfg)
+    train_metrics = []
+    if cfg.is_provide_training_metric:
+        train_metrics = create_metrics(metric_names, cfg, inner.metadata,
+                                       inner.num_data)
+    gbdt = create_boosting(cfg.boosting_type())
+    gbdt.init(cfg, inner, objective, train_metrics)
+    return _BoosterHandle(gbdt, cfg, train_data)
+
+
+def LGBM_BoosterCreateFromModelfile(filename: str) -> _BoosterHandle:
+    """c_api.cpp:527."""
+    from .models.gbdt import GBDT
+    g = GBDT()
+    with open(filename) as fh:
+        g.load_model_from_string(fh.read())
+    return _BoosterHandle(g, Config(), None)
+
+
+def LGBM_BoosterLoadModelFromString(model_str: str) -> _BoosterHandle:
+    from .models.gbdt import GBDT
+    g = GBDT()
+    g.load_model_from_string(model_str)
+    return _BoosterHandle(g, Config(), None)
+
+
+def LGBM_BoosterFree(handle: _BoosterHandle):
+    handle.gbdt = None
+    return 0
+
+
+def LGBM_BoosterAddValidData(handle: _BoosterHandle,
+                             valid_data: _DatasetHandle):
+    """c_api.cpp:560."""
+    valid_data.reference = handle.train
+    inner = valid_data.construct()
+    metric_names = _resolve_metric_names(handle.cfg)
+    metrics = create_metrics(metric_names, handle.cfg, inner.metadata,
+                             inner.num_data)
+    handle.gbdt.add_valid_data(inner, metrics, "valid")
+    return 0
+
+
+def LGBM_BoosterUpdateOneIter(handle: _BoosterHandle):
+    """c_api.cpp:605 — returns is_finished (out-param -> return)."""
+    return 1 if handle.gbdt.train_one_iter() else 0
+
+
+def LGBM_BoosterUpdateOneIterCustom(handle: _BoosterHandle, grad, hess):
+    """c_api.cpp:621."""
+    return 1 if handle.gbdt.train_one_iter(
+        np.asarray(grad, np.float32), np.asarray(hess, np.float32)) else 0
+
+
+def LGBM_BoosterRollbackOneIter(handle: _BoosterHandle):
+    handle.gbdt.rollback_one_iter()
+    return 0
+
+
+def LGBM_BoosterGetCurrentIteration(handle: _BoosterHandle) -> int:
+    return handle.gbdt.current_iteration
+
+
+def LGBM_BoosterGetNumClasses(handle: _BoosterHandle) -> int:
+    return handle.gbdt.num_class
+
+
+def LGBM_BoosterGetEval(handle: _BoosterHandle, data_idx: int):
+    """c_api.cpp:693 — [(name, value)] for train (0) / valid (1..)."""
+    return [(name, val) for name, val, _ in
+            handle.gbdt.get_eval_at(data_idx)]
+
+
+def LGBM_BoosterGetEvalNames(handle: _BoosterHandle):
+    return [name for name, _, _ in handle.gbdt.get_eval_at(0)]
+
+
+def _predict(gbdt, X, predict_type, num_iteration):
+    if predict_type == C_API_PREDICT_RAW_SCORE:
+        return gbdt.predict_raw(X, num_iteration)
+    if predict_type == C_API_PREDICT_LEAF_INDEX:
+        return gbdt.predict_leaf_index(X, num_iteration)
+    if predict_type == C_API_PREDICT_CONTRIB:
+        return gbdt.predict_contrib(X, num_iteration)
+    return gbdt.predict(X, num_iteration)
+
+
+def LGBM_BoosterPredictForMat(handle: _BoosterHandle, data,
+                              data_type=C_API_DTYPE_FLOAT64, nrow=None,
+                              ncol=None, is_row_major=1,
+                              predict_type=C_API_PREDICT_NORMAL,
+                              num_iteration=-1, parameter=""):
+    """c_api.cpp:1014."""
+    X = _mat_to_2d(data, nrow, ncol, is_row_major)
+    return _predict(handle.gbdt, X, predict_type, num_iteration)
+
+
+def LGBM_BoosterPredictForCSR(handle: _BoosterHandle, indptr, indptr_type,
+                              indices, data, data_type, nindptr, nelem,
+                              num_col, predict_type=C_API_PREDICT_NORMAL,
+                              num_iteration=-1, parameter=""):
+    """c_api.cpp:878."""
+    X = _csr_to_dense(indptr, indices, data, int(num_col))
+    return _predict(handle.gbdt, X, predict_type, num_iteration)
+
+
+def LGBM_BoosterPredictForFile(handle: _BoosterHandle, data_filename,
+                               data_has_header=0,
+                               predict_type=C_API_PREDICT_NORMAL,
+                               num_iteration=-1, parameter="",
+                               result_filename="LightGBM_predict_result.txt"):
+    """c_api.cpp:836."""
+    from .io.loader import DatasetLoader
+    cfg = _params_to_config(parameter)
+    cfg.header = bool(data_has_header)
+    loader = DatasetLoader(cfg)
+    X, _ = loader.load_predict_matrix(
+        data_filename, handle.gbdt.max_feature_idx + 1)
+    out = np.asarray(_predict(handle.gbdt, X, predict_type,
+                              num_iteration))
+    with open(result_filename, "w") as fh:
+        if out.ndim == 1:
+            fh.writelines(f"{v:g}\n" for v in out)
+        else:
+            fh.writelines("\t".join(f"{v:g}" for v in row) + "\n"
+                          for row in out)
+    return 0
+
+
+def LGBM_BoosterCalcNumPredict(handle: _BoosterHandle, num_row: int,
+                               predict_type=C_API_PREDICT_NORMAL,
+                               num_iteration=-1) -> int:
+    """c_api.cpp:818."""
+    g = handle.gbdt
+    k = max(g.num_tree_per_iteration, 1)
+    if predict_type == C_API_PREDICT_LEAF_INDEX:
+        ntree = len(g.models)
+        if num_iteration > 0:
+            ntree = min(ntree, num_iteration * k)
+        return num_row * ntree
+    if predict_type == C_API_PREDICT_CONTRIB:
+        return num_row * k * (g.max_feature_idx + 2)
+    return num_row * k
+
+
+def LGBM_BoosterSaveModel(handle: _BoosterHandle, num_iteration=-1,
+                          filename="LightGBM_model.txt",
+                          start_iteration=0):
+    handle.gbdt.save_model_to_file(filename, start_iteration,
+                                   num_iteration)
+    return 0
+
+
+def LGBM_BoosterSaveModelToString(handle: _BoosterHandle,
+                                  num_iteration=-1,
+                                  start_iteration=0) -> str:
+    return handle.gbdt.model_to_string(start_iteration, num_iteration)
+
+
+def LGBM_BoosterDumpModel(handle: _BoosterHandle, num_iteration=-1,
+                          start_iteration=0) -> dict:
+    return handle.gbdt.dump_model(start_iteration, num_iteration)
+
+
+def LGBM_BoosterFeatureImportance(handle: _BoosterHandle,
+                                  num_iteration=0,
+                                  importance_type=0) -> np.ndarray:
+    kind = "split" if importance_type == 0 else "gain"
+    return handle.gbdt.feature_importance(kind, num_iteration)
+
+
+def LGBM_BoosterGetNumFeature(handle: _BoosterHandle) -> int:
+    return handle.gbdt.max_feature_idx + 1
+
+
+def LGBM_BoosterResetParameter(handle: _BoosterHandle, parameters):
+    cfg = handle.cfg
+    if isinstance(parameters, str):
+        cfg.set(Config.str2map(parameters))
+    else:
+        cfg.set({k: str(v) for k, v in parameters.items()})
+    handle.gbdt.shrinkage_rate = cfg.learning_rate
+    handle.gbdt._setup_grower()
+    return 0

@@ -177,7 +177,8 @@ class TpuDataset:
                 missing_type=np.zeros(1, np.int32),
                 default_bin=np.zeros(1, np.int32),
                 monotone=np.zeros(1, np.int32),
-                penalty=np.ones(1, np.float32))
+                penalty=np.ones(1, np.float32),
+                is_cat=np.zeros(1, np.int32))
         mono = None
         if self.config.monotone_constraints:
             mono = [0] * self.num_features

@@ -1,0 +1,292 @@
+"""Windowed cache-admission training driver (the fork's application).
+
+TPU-native counterpart of the fork's actual main program
+(reference: src/test.cpp:39-341): a learning-relaxed-Belady loop that,
+per fixed-size window of (id, size, cost) cache requests,
+
+1. labels each request by an OPT-like volume ranking (calculateOPT,
+   test.cpp:97-121): requests whose next-use volume fits the cache's
+   byte-window budget get toCache = 1;
+2. derives features (deriveFeatures, test.cpp:124-208): up to 50
+   inter-arrival gaps, log2 object size, log2 available cache bytes,
+   and the request cost, as a CSR matrix;
+3. trains a FRESH booster on the window's sample with the fork's fixed
+   parameter set (trainModel, test.cpp:240-298);
+4. evaluates the previous booster on the next window, reporting
+   false-positive / false-negative rates at ``cutoff`` plus the OPT
+   object/byte hit ratios (evaluateModel, test.cpp:210-238).
+
+Run: ``python -m lightgbm_tpu.lrb <trace> <cacheSize> <windowSize>
+<sampleSize> <cutoff> <sampling> [result_file]`` — the same argv as the
+reference binary. ``trace`` rows: ``seq id size cost`` (or
+``id size cost``; a synthetic trace generator is included for testing).
+"""
+from __future__ import annotations
+
+import sys
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import capi
+from .utils import log
+
+HISTFEATURES = 50            # test.cpp:16
+NUM_FEATURES = HISTFEATURES + 3
+
+TRAIN_PARAMS = {             # test.cpp:67-87
+    "boosting": "gbdt",
+    "objective": "binary",
+    "metric": "binary_logloss,auc",
+    "metric_freq": "1",
+    "is_provide_training_metric": "true",
+    "max_bin": "255",
+    "num_iterations": "50",
+    "learning_rate": "0.1",
+    "num_leaves": "31",
+    "tree_learner": "serial",
+    "feature_fraction": "0.8",
+    "bagging_freq": "5",
+    "bagging_fraction": "0.8",
+    "min_data_in_leaf": "50",
+    "min_sum_hessian_in_leaf": "5.0",
+    "verbose": "-1",
+}
+
+
+class Window:
+    """One window's trace + OPT bookkeeping (test.cpp globals)."""
+
+    def __init__(self):
+        self.ids: List[int] = []
+        self.sizes: List[int] = []
+        self.costs: List[float] = []
+        self.to_cache: Optional[np.ndarray] = None
+        self.has_next: List[bool] = []
+        self.volume: List[int] = []
+        self.byte_sum = 0
+
+
+class LrbDriver:
+    """The windowed retraining loop (test.cpp:300-341 processRequest)."""
+
+    def __init__(self, cache_size: int, window_size: int,
+                 sample_size: int, cutoff: float, sampling: int,
+                 result_file=sys.stdout, seed: int = 0):
+        self.cache_size = cache_size
+        self.window_size = window_size
+        self.sample_size = sample_size
+        self.cutoff = cutoff
+        self.sampling = sampling
+        self.out = result_file
+        self.rng = np.random.default_rng(seed)
+        self.booster = None
+        self.window = Window()
+        self.last_seen: Dict[Tuple[int, int], int] = {}
+        # per-id inter-arrival history carried ACROSS windows is reset
+        # with the window in the reference (statistics is local to
+        # deriveFeatures) — mirrored here
+        self.window_index = 0
+        self.results: List[dict] = []
+
+    # -- request ingestion ---------------------------------------------------
+
+    def process_request(self, seq: int, obj_id: int, size: int,
+                        cost: float) -> None:
+        w = self.window
+        idx = (seq - 1) % self.window_size
+        key = (obj_id, size)
+        if size > 0 and key in self.last_seen:
+            prev = self.last_seen[key]
+            w.has_next[prev] = True
+            w.volume[prev] = (idx - prev) * size
+        w.byte_sum += size
+        self.last_seen[key] = idx
+        w.ids.append(obj_id)
+        w.sizes.append(size)
+        w.costs.append(cost)
+        w.has_next.append(False)
+        w.volume.append(np.iinfo(np.int64).max)
+        if seq % self.window_size == 0:
+            self._process_window()
+
+    def _process_window(self) -> None:
+        self.window_index += 1
+        self._calculate_opt()
+        rec = {"window": self.window_index}
+        if self.booster is not None:
+            rec.update(self._evaluate_model())
+        labels, X = self._derive_features(self.sampling)
+        rec["train_rows"] = len(labels)
+        self._train_model(labels, X)
+        rec.update(self._opt_ratios())
+        self.results.append(rec)
+        print(f"window {self.window_index}: "
+              + " ".join(f"{k}={v}" for k, v in rec.items()),
+              file=self.out)
+        self.window = Window()
+        self.last_seen.clear()
+
+    # -- OPT labeling (test.cpp:97-121) --------------------------------------
+
+    def _calculate_opt(self) -> None:
+        w = self.window
+        n = len(w.ids)
+        volume = np.asarray(w.volume, np.int64)
+        has_next = np.asarray(w.has_next, bool)
+        order = np.argsort(volume, kind="stable")
+        cache_volume = self.cache_size * self.window_size
+        to_cache = np.zeros(n, bool)
+        cur = 0
+        self._opt_hits = 0
+        self._opt_byte_hits = 0
+        sizes = np.asarray(w.sizes, np.int64)
+        for i in order:
+            if cur > cache_volume:
+                break
+            if has_next[i]:
+                to_cache[i] = True
+                self._opt_hits += 1
+                self._opt_byte_hits += int(sizes[i])
+                cur += int(volume[i])
+        w.to_cache = to_cache
+
+    def _opt_ratios(self) -> dict:
+        w = self.window
+        return {
+            "opt_obj_hit_ratio": round(self._opt_hits
+                                       / self.window_size, 4),
+            "opt_byte_hit_ratio": round(self._opt_byte_hits
+                                        / max(w.byte_sum, 1), 4),
+        }
+
+    # -- feature derivation (test.cpp:124-208) -------------------------------
+
+    def _derive_features(self, sampling: int):
+        w = self.window
+        n = len(w.ids)
+        cache_avail = self.cache_size
+        history: Dict[int, deque] = {}
+        cache: Dict[int, int] = {}
+        labels: List[float] = []
+        rows: List[np.ndarray] = []
+        for i in range(n):
+            q = history.setdefault(w.ids[i], deque())
+            if len(q) > HISTFEATURES:
+                q.pop()
+            flag = True
+            if sampling == 1:
+                flag = i >= (self.window_size - self.sample_size)
+            elif sampling == 2:
+                flag = self.rng.random() < self.sample_size \
+                    / self.window_size
+            if flag:
+                labels.append(1.0 if w.to_cache[i] else 0.0)
+                feat = np.zeros(NUM_FEATURES, np.float64)
+                last = i
+                for j, t in enumerate(q):
+                    feat[j] = last - t
+                    last = t
+                feat[HISTFEATURES] = round(
+                    100.0 * np.log2(max(w.sizes[i], 1)))
+                feat[HISTFEATURES + 1] = (
+                    0.0 if cache_avail <= 0
+                    else round(100.0 * np.log2(cache_avail)))
+                feat[HISTFEATURES + 2] = w.costs[i]
+                rows.append(feat)
+            # cache-occupancy bookkeeping (test.cpp:180-199)
+            oid = w.ids[i]
+            if oid not in cache:
+                if w.to_cache[i]:
+                    cache_avail -= w.sizes[i]
+                    cache[oid] = w.sizes[i]
+            else:
+                if not w.to_cache[i]:
+                    cache_avail += cache.pop(oid)
+            q.appendleft(i)
+        X = (np.stack(rows) if rows
+             else np.zeros((0, NUM_FEATURES), np.float64))
+        return np.asarray(labels, np.float32), X
+
+    # -- train / evaluate (test.cpp:210-298) ---------------------------------
+
+    def _train_model(self, labels: np.ndarray, X: np.ndarray) -> None:
+        if len(labels) == 0 or len(np.unique(labels)) < 2:
+            log.warning("window %d: degenerate labels; keeping previous "
+                        "model", self.window_index)
+            return
+        ds = capi.LGBM_DatasetCreateFromMat(X, parameters=TRAIN_PARAMS)
+        capi.LGBM_DatasetSetField(ds, "label", labels)
+        # always a FRESH booster per window (test.cpp:281-295)
+        booster = capi.LGBM_BoosterCreate(ds, TRAIN_PARAMS)
+        for _ in range(int(TRAIN_PARAMS["num_iterations"])):
+            if capi.LGBM_BoosterUpdateOneIter(booster):
+                break
+        self.booster = booster
+
+    def _evaluate_model(self) -> dict:
+        labels, X = self._derive_features(0)
+        preds = capi.LGBM_BoosterPredictForMat(
+            self.booster, X, predict_type=capi.C_API_PREDICT_NORMAL)
+        preds = np.asarray(preds)
+        fp = ((labels < self.cutoff) & (preds >= self.cutoff)).sum()
+        fn = ((labels >= self.cutoff) & (preds < self.cutoff)).sum()
+        return {"eval_rows": len(labels),
+                "fp_rate": round(float(fp) / max(len(labels), 1), 4),
+                "fn_rate": round(float(fn) / max(len(labels), 1), 4)}
+
+
+# ---------------------------------------------------------------------------
+# trace IO + synthetic generator
+# ---------------------------------------------------------------------------
+
+def run_trace_file(path: str, cache_size: int, window_size: int,
+                   sample_size: int, cutoff: float, sampling: int,
+                   result_file=sys.stdout) -> LrbDriver:
+    driver = LrbDriver(cache_size, window_size, sample_size, cutoff,
+                       sampling, result_file)
+    seq = 0
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if not parts:
+                continue
+            if len(parts) >= 4:
+                _, obj_id, size, cost = parts[:4]
+            else:
+                obj_id, size, cost = parts[:3]
+            seq += 1
+            driver.process_request(seq, int(obj_id), int(float(size)),
+                                   float(cost))
+    return driver
+
+
+def synthetic_trace(n_requests: int, n_objects: int = 200,
+                    seed: int = 7):
+    """Zipf-ish request stream for tests: popular objects recur."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, n_objects + 1)
+    p = (1.0 / ranks) / (1.0 / ranks).sum()
+    ids = rng.choice(n_objects, size=n_requests, p=p)
+    sizes = (2 ** rng.integers(6, 14, n_objects))
+    for i, oid in enumerate(ids):
+        yield i + 1, int(oid), int(sizes[oid]), 1.0
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 6:
+        print("parameters: tracePath cacheSize windowSize sampleSize "
+              "cutoff sampling [resultFile]", file=sys.stderr)
+        sys.exit(1)
+    trace, cache_size, window_size, sample_size, cutoff, sampling = \
+        argv[0], int(argv[1]), int(argv[2]), int(argv[3]), \
+        float(argv[4]), int(argv[5])
+    out = open(argv[6], "w") if len(argv) > 6 else sys.stdout
+    run_trace_file(trace, cache_size, window_size, sample_size, cutoff,
+                   sampling, out)
+
+
+if __name__ == "__main__":
+    main()

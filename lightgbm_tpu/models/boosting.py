@@ -269,8 +269,6 @@ class RF(GBDT):
         grower = self._grower
         K = self.num_tree_per_iteration
         n, pad_rows = self._n, self._pad_rows
-        bins = self._bins_dev
-        valid_bins = tuple(self._valid_bins_dev)
         meta = self._meta
         obj = self.objective
         L = self._grower_cfg.num_leaves
@@ -286,8 +284,8 @@ class RF(GBDT):
             renew_w = None if w is None else jnp.asarray(w, jnp.float32)
             renew_alpha = float(obj.renew_tree_output_percentile())
 
-        def step(scores, valid_scores, mask, fmask, iter_f, init_bias,
-                 g_in, h_in, key):
+        def step(bins, valid_bins, scores, valid_scores, mask, fmask,
+                 iter_f, init_bias, g_in, h_in, key):
             recs = []
             vs = list(valid_scores)
             for k in range(K):
@@ -312,8 +310,8 @@ class RF(GBDT):
                 upd = (scores[k] * iter_f + rec.leaf_output[leaf_ids]) \
                     / (iter_f + 1.0)
                 scores = scores.at[k].set(jnp.where(grew, upd, scores[k]))
-                for vi, vb in enumerate(valid_bins):
-                    vleaf = replay_partition(rec, vb, meta)
+                for vi in range(len(vs)):
+                    vleaf = replay_partition(rec, valid_bins[vi], meta)
                     vupd = (vs[vi][k] * iter_f
                             + rec.leaf_output[vleaf]) / (iter_f + 1.0)
                     vs[vi] = vs[vi].at[k].set(
@@ -321,7 +319,7 @@ class RF(GBDT):
                 recs.append(rec)
             return scores, tuple(vs), recs
 
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+        self._step_fn = jax.jit(step, donate_argnums=(2, 3))
         self._step_key = key_id
         return self._step_fn
 
@@ -341,6 +339,7 @@ class RF(GBDT):
         fmask = self._feature_mask_dev()
         step = self._get_step_fn(False)
         self._scores, new_valids, recs = step(
+            self._bins_dev, tuple(self._valid_bins_dev),
             self._scores, tuple(self._valid_scores), mask, fmask,
             jnp.float32(self.iter_), self._zero_bias, self._rf_g,
             self._rf_h, self._dummy_key)

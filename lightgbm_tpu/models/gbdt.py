@@ -140,7 +140,13 @@ class GBDT:
             max_delta_step=cfg.max_delta_step,
             min_data_in_leaf=float(cfg.min_data_in_leaf),
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
-            min_gain_to_split=cfg.min_gain_to_split)
+            min_gain_to_split=cfg.min_gain_to_split,
+            max_cat_to_onehot=cfg.max_cat_to_onehot,
+            max_cat_threshold=cfg.max_cat_threshold,
+            cat_l2=cfg.cat_l2, cat_smooth=cfg.cat_smooth,
+            min_data_per_group=float(cfg.min_data_per_group),
+            has_cat=any(m.bin_type == 1
+                        for m in self.train_data.mappers))
 
         # distributed learner selection (tree_learner.cpp:9-33 analog):
         # tree_learner = serial|feature|data|voting over the device mesh
@@ -178,7 +184,11 @@ class GBDT:
                     monotone=np.concatenate(
                         [meta.monotone, np.zeros(pad, np.int32)]),
                     penalty=np.concatenate(
-                        [meta.penalty, np.ones(pad, np.float32)]))
+                        [meta.penalty, np.ones(pad, np.float32)]),
+                    is_cat=np.concatenate(
+                        [np.broadcast_to(np.asarray(meta.is_cat,
+                                                    np.int32), (f,)),
+                         np.zeros(pad, np.int32)]))
                 self._meta = meta
         self._n_pad = self._n + self._pad_rows
         self._f_pad = f + self._pad_features
@@ -368,8 +378,6 @@ class GBDT:
         grower = self._grower
         K = self.num_tree_per_iteration
         n, pad_rows = self._n, self._pad_rows
-        bins = self._bins_dev
-        valid_bins = tuple(self._valid_bins_dev)
         meta = self._meta
         L = self._grower_cfg.num_leaves
         renew = (not custom) and obj is not None \
@@ -387,8 +395,11 @@ class GBDT:
 
         sample_hook = self._sample_hook
 
-        def step(scores, valid_scores, mask, fmask, shrink, init_bias,
-                 g_in, h_in, key):
+        # bins/valid bins are ARGUMENTS, not closure constants: closed-
+        # over arrays embed into the lowered program, and at 11M rows
+        # the 308 MB constant blows the compile-RPC size limit
+        def step(bins, valid_bins, scores, valid_scores, mask, fmask,
+                 shrink, init_bias, g_in, h_in, key):
             if custom:
                 g_all, h_all = g_in, h_in
             else:
@@ -430,8 +441,8 @@ class GBDT:
                 # out-of-bag rows included: the partition covers ALL rows
                 scores = scores.at[k].set(add_leaf_outputs(
                     scores[k], leaf_ids, rec.leaf_output, 1.0))
-                for vi, vb in enumerate(valid_bins):
-                    vleaf = replay_partition(rec, vb, meta)
+                for vi in range(len(vs)):
+                    vleaf = replay_partition(rec, valid_bins[vi], meta)
                     vs[vi] = vs[vi].at[k].set(add_leaf_outputs(
                         vs[vi][k], vleaf, rec.leaf_output, 1.0))
                 # AddBias on the STORED record only (tree.h:151): the
@@ -447,7 +458,7 @@ class GBDT:
                 recs.append(rec)
             return scores, tuple(vs), recs
 
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+        self._step_fn = jax.jit(step, donate_argnums=(2, 3))
         self._step_key = key
         return self._step_fn
 
@@ -498,6 +509,7 @@ class GBDT:
         else:
             key = self._dummy_key
         self._scores, new_valids, recs = step(
+            self._bins_dev, tuple(self._valid_bins_dev),
             self._scores, tuple(self._valid_scores), mask, fmask,
             jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in, key)
         self._valid_scores = list(new_valids)

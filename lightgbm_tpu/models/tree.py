@@ -97,6 +97,54 @@ class Tree:
         self.num_leaves += 1
         return node
 
+    def split_categorical(self, leaf: int, feature: int,
+                          cat_values, left_value: float,
+                          right_value: float, left_count: int,
+                          right_count: int, gain: float,
+                          missing_type: int) -> int:
+        """Tree::SplitCategorical (src/io/tree.cpp): the left-set is a
+        bitset over CATEGORY values; threshold_in_bin/threshold index
+        into cat_boundaries."""
+        cat_values = sorted(int(v) for v in cat_values if v >= 0)
+        max_cat = max(cat_values, default=0)
+        n_words = max_cat // 32 + 1
+        words = [0] * n_words
+        for v in cat_values:
+            words[v // 32] |= 1 << (v % 32)
+        ci = self.num_cat
+        node = self.num_leaves - 1
+        ptr = self._leaf_ptr.get(leaf)
+        if ptr is not None:
+            pnode, is_left = ptr
+            if is_left:
+                self.left_child[pnode] = node
+            else:
+                self.right_child[pnode] = node
+        dtype = K_CATEGORICAL_MASK | ((missing_type & 3) << 2)
+        self.split_feature.append(feature)
+        self.split_gain.append(gain)
+        self.threshold_in_bin.append(ci)
+        self.threshold.append(float(ci))
+        self.decision_type.append(dtype)
+        self.left_child.append(~leaf)
+        self.right_child.append(~self.num_leaves)
+        self.internal_value.append(
+            self.leaf_value[leaf] if leaf < len(self.leaf_value) else 0.0)
+        self.internal_count.append(left_count + right_count)
+        new_leaf = self.num_leaves
+        self._leaf_ptr[leaf] = (node, True)
+        self._leaf_ptr[new_leaf] = (node, False)
+        if leaf < len(self.leaf_value):
+            self.leaf_value[leaf] = left_value
+            self.leaf_count[leaf] = left_count
+        self.leaf_value.append(right_value)
+        self.leaf_count.append(right_count)
+        self.num_leaves += 1
+        self.cat_boundaries.append(self.cat_boundaries[-1] + n_words)
+        self.cat_threshold.extend(words)
+        self.num_cat += 1
+        return node
+
     def set_internal_value(self, node: int, value: float) -> None:
         self.internal_value[node] = value
 
@@ -329,7 +377,9 @@ class Tree:
         t.leaf_count = ints("leaf_count", [0] * nl)
         t.internal_value = floats("internal_value", [0.0] * (nl - 1))
         t.internal_count = ints("internal_count", [0] * (nl - 1))
-        t.threshold_in_bin = [0] * (nl - 1)
+        t.threshold_in_bin = [
+            int(th) if (dt & K_CATEGORICAL_MASK) else 0
+            for th, dt in zip(t.threshold, t.decision_type)]
         if t.num_cat > 0:
             t.cat_boundaries = ints("cat_boundaries")
             t.cat_threshold = ints("cat_threshold")
@@ -472,11 +522,10 @@ def record_arrays_from_tree(tree: Tree, real_to_inner: dict, mappers,
         "leaf_sum_h": np.zeros(L, np.float32),
         "internal_value": np.zeros(s, np.float32),
         "internal_count": np.zeros(s, np.float32),
+        "split_is_cat": np.zeros(s, bool),
+        "split_cat_words": np.zeros((s, 8), np.int32),
     }
     for i in range(nl - 1):
-        if tree.decision_type[i] & K_CATEGORICAL_MASK:
-            log.fatal("Continued training from categorical splits is not "
-                      "supported yet")
         c = tree.left_child[i]
         while c >= 0:
             c = tree.left_child[c]
@@ -487,11 +536,24 @@ def record_arrays_from_tree(tree: Tree, real_to_inner: dict, mappers,
             log.fatal(f"Loaded model splits on feature {real} which is "
                       "trivial/unused in the new training data")
         out["split_feature"][i] = inner
-        out["split_bin"][i] = int(mappers[inner].value_to_bin(
-            np.asarray([tree.threshold[i]]))[0])
+        if tree.decision_type[i] & K_CATEGORICAL_MASK:
+            # category-space bitset -> bin-space words via the mapper
+            ci = tree.threshold_in_bin[i]
+            lo, hi = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+            words = np.zeros(8, np.uint32)
+            for cat, b in mappers[inner].categorical_2_bin.items():
+                w = cat // 32
+                if lo + w < hi and b < 256 and cat >= 0 \
+                        and (tree.cat_threshold[lo + w] >> (cat % 32)) & 1:
+                    words[b // 32] |= np.uint32(1 << (b % 32))
+            out["split_is_cat"][i] = True
+            out["split_cat_words"][i] = words.astype(np.int32)
+        else:
+            out["split_bin"][i] = int(mappers[inner].value_to_bin(
+                np.asarray([tree.threshold[i]]))[0])
+            out["split_default_left"][i] = bool(
+                tree.decision_type[i] & K_DEFAULT_LEFT_MASK)
         out["split_gain"][i] = tree.split_gain[i]
-        out["split_default_left"][i] = bool(
-            tree.decision_type[i] & K_DEFAULT_LEFT_MASK)
         out["internal_value"][i] = tree.internal_value[i]
         out["internal_count"][i] = tree.internal_count[i]
     out["leaf_output"][:nl] = tree.leaf_value[:nl]
@@ -510,6 +572,8 @@ def tree_from_record(rec, mappers, real_features, shrinkage: float,
               else {k: np.asarray(v) for k, v in rec._asdict().items()})
     nl = int(rec_np["num_leaves"])
     t = Tree(max_leaves)
+    cat_flags = rec_np.get("split_is_cat")
+    cat_words = rec_np.get("split_cat_words")
     for i in range(nl - 1):
         leaf = int(rec_np["split_leaf"][i])
         if leaf < 0:
@@ -517,17 +581,33 @@ def tree_from_record(rec, mappers, real_features, shrinkage: float,
         feat = int(rec_np["split_feature"][i])
         tbin = int(rec_np["split_bin"][i])
         mapper = mappers[feat]
-        node = t.split(
-            leaf=leaf,
-            feature=int(real_features[feat]),
-            threshold_bin=tbin,
-            threshold_real=mapper.bin_to_value(tbin),
-            left_value=0.0, right_value=0.0,
-            left_count=0, right_count=0,
-            gain=float(rec_np["split_gain"][i]),
-            missing_type=mapper.missing_type,
-            default_left=bool(rec_np["split_default_left"][i]),
-        )
+        if cat_flags is not None and bool(cat_flags[i]):
+            # bin-space bitset -> category values via the mapper
+            words = np.asarray(cat_words[i]).astype(np.int64)
+            cats = [mapper.bin_2_categorical[b]
+                    for b in range(len(mapper.bin_2_categorical))
+                    if (words[b // 32] >> (b % 32)) & 1]
+            node = t.split_categorical(
+                leaf=leaf,
+                feature=int(real_features[feat]),
+                cat_values=cats,
+                left_value=0.0, right_value=0.0,
+                left_count=0, right_count=0,
+                gain=float(rec_np["split_gain"][i]),
+                missing_type=mapper.missing_type,
+            )
+        else:
+            node = t.split(
+                leaf=leaf,
+                feature=int(real_features[feat]),
+                threshold_bin=tbin,
+                threshold_real=mapper.bin_to_value(tbin),
+                left_value=0.0, right_value=0.0,
+                left_count=0, right_count=0,
+                gain=float(rec_np["split_gain"][i]),
+                missing_type=mapper.missing_type,
+                default_left=bool(rec_np["split_default_left"][i]),
+            )
         t.set_internal_value(node, float(rec_np["internal_value"][i]))
         t.internal_count[node] = int(round(float(rec_np["internal_count"][i])))
     for leaf in range(nl):

@@ -57,6 +57,8 @@ class TreeRecord(NamedTuple):
     leaf_sum_h: jax.Array          # [L]
     internal_value: jax.Array      # [L-1] parent raw output at split time
     internal_count: jax.Array      # [L-1]
+    split_is_cat: jax.Array        # [L-1] bool categorical split flag
+    split_cat_words: jax.Array     # [L-1, 8] int32 left-set bin bitset
 
 
 @jax.jit
@@ -69,6 +71,11 @@ def pack_record(rec: TreeRecord) -> jax.Array:
     transfers per tree. float32 holds counts/bins exactly below 2^24.
     """
     f32 = jnp.float32
+    # cat words carry full 32-bit patterns: split into exact 16-bit
+    # halves (f32 holds ints < 2^24 exactly; a raw int32 would round)
+    w = rec.split_cat_words.astype(jnp.uint32)
+    w_lo = jnp.bitwise_and(w, jnp.uint32(0xFFFF)).astype(f32)
+    w_hi = jnp.right_shift(w, jnp.uint32(16)).astype(f32)
     return jnp.concatenate([
         rec.num_leaves[None].astype(f32) if rec.num_leaves.ndim == 0
         else rec.num_leaves.astype(f32),
@@ -83,6 +90,9 @@ def pack_record(rec: TreeRecord) -> jax.Array:
         rec.leaf_sum_h.astype(f32),
         rec.internal_value.astype(f32),
         rec.internal_count.astype(f32),
+        rec.split_is_cat.astype(f32),
+        w_lo.reshape(-1),
+        w_hi.reshape(-1),
     ])
 
 
@@ -100,6 +110,14 @@ def unpack_record(arr, num_leaves_cap: int) -> dict:
         parts[name] = arr[off:off + L]; off += L
     for name in ("internal_value", "internal_count"):
         parts[name] = arr[off:off + s]; off += s
+    parts["split_is_cat"] = arr[off:off + s] > 0.5; off += s
+    import numpy as _np
+    w_lo = _np.asarray(arr[off:off + s * 8]).reshape(s, 8); off += s * 8
+    w_hi = _np.asarray(arr[off:off + s * 8]).reshape(s, 8); off += s * 8
+    parts["split_cat_words"] = (
+        w_lo.astype(_np.int64)
+        + (w_hi.astype(_np.int64) << 16)).astype(_np.uint32).astype(
+            _np.int32)
     return parts
 
 
@@ -132,6 +150,10 @@ class _State(NamedTuple):
 def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                      hist_fn=None, split_fn=None, col_fn=None,
                      reduce_fn=None, jit=True):
+    """NOTE: this legacy strict leaf-wise grower is the numerical-only
+    correctness oracle (tests/test_wave_ops.py W=1 parity); it does not
+    thread categorical splits, so the search is compiled out."""
+    cfg = cfg._replace(hp=cfg.hp._replace(has_cat=False))
     """Build a ``grow(bins, grad, hess, sample_mask, feature_mask)``.
 
     Injection seams for the parallel learners (SURVEY §2.2):
@@ -244,6 +266,8 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
                 leaf_sum_h=jnp.zeros(L, f32),
                 internal_value=jnp.zeros(L - 1, f32),
                 internal_count=jnp.zeros(L - 1, f32),
+                split_is_cat=jnp.zeros(L - 1, bool),
+                split_cat_words=jnp.zeros((L - 1, 8), jnp.int32),
             ),
         )
 

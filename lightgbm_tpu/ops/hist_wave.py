@@ -282,7 +282,7 @@ def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     if use_pallas:
         return wave_histogram_pallas(
             bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
-            chunk=chunk or 2048, precision=precision)
+            chunk=chunk or 8192, precision=precision)
     return wave_histogram_xla(
         bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
         chunk=0, precision=precision)
@@ -292,10 +292,12 @@ def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 # Fused partition + wave histogram Pallas kernel
 # ---------------------------------------------------------------------------
 
-# rows of the packed per-slot split table (int32 [16, 128])
+# rows of the packed per-slot split table (int32, transposed to
+# [128, TBL_ROWS] at the kernel boundary)
 TBL_PARENT, TBL_NEW, TBL_FEAT, TBL_BIN, TBL_DLEFT = 0, 1, 2, 3, 4
-TBL_MISS, TBL_DEFBIN, TBL_NUMBIN, TBL_SMALL = 5, 6, 7, 8
-TBL_ROWS = 16           # padded to an int32 sublane multiple
+TBL_MISS, TBL_DEFBIN, TBL_NUMBIN, TBL_SMALL, TBL_ISCAT = 5, 6, 7, 8, 9
+TBL_CATW = 10           # 8 bitset words (left-set bins) follow
+TBL_ROWS = 24           # padded to an int32 sublane multiple
 
 FUSED_MAX_WAVE = 32          # 4 channels x W <= 128 MXU lanes (bf16 h)
 FUSED_MAX_WAVE_HILO = 24     # 5 channels, kept a multiple of 8
@@ -313,8 +315,10 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     columns of the transposed table, and the weight matrix is built
     transposed for a lane-contracting MXU dot. No relayouts.
 
-    tbl_ref:   [128, 16] i32 packed split table (row k = wave slot k,
-               column j = TBL_* field j; parent -1 = inactive slot)
+    tbl_ref:   [128, TBL_ROWS=24] i32 packed split table (row k = wave
+               slot k, column j = TBL_* field j: 10 scalar fields then
+               8 categorical left-set bitset words; parent -1 =
+               inactive slot)
     binsf_ref: [F, Ct]  feature-major bins (uint8)
     ghm_ref:   [4, Ct]  f32 rows (grad, hess, bag_mask, 0); grad/hess
                pre-masked, the mask rides separately for the counts
@@ -349,6 +353,7 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     parent_c = tbl_ref[:W, TBL_PARENT:TBL_PARENT + 1]
     new_c = tbl_ref[:W, TBL_NEW:TBL_NEW + 1]
     small_c = tbl_ref[:W, TBL_SMALL:TBL_SMALL + 1]
+    iscat_c = tbl_ref[:W, TBL_ISCAT:TBL_ISCAT + 1]
 
     # ---- partition (DataPartition::Split, data_partition.hpp:109) ----
     # cols[k, :] = bins of slot k's split feature: select among the
@@ -364,6 +369,19 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
                   | ((miss_c == 1) & (cols == defb_c)))
     right = ((is_missing & (dleft_c == 0))
              | (~is_missing & (cols > bin_c)))
+    # categorical: the bin's bit set in the slot's left bitset -> LEFT
+    # (dense_bin.hpp SplitCategorical); unseen/NaN bins go right
+    widx = jnp.right_shift(cols, 5)
+    word = jnp.zeros_like(cols)
+    for wq in range(8):
+        word = jnp.where(widx == wq,
+                         tbl_ref[:W, TBL_CATW + wq:TBL_CATW + wq + 1],
+                         word)
+    cat_left = jnp.bitwise_and(
+        jnp.right_shift(word, jnp.bitwise_and(cols, 31)), 1) != 0
+    # logical form (no bool select — see `right` above)
+    iscat_b = iscat_c > 0
+    right = (iscat_b & ~cat_left) | (~iscat_b & right)
     moved = (leaf == parent_c) & right & (parent_c >= 0)    # [W, Ct]
     any_moved = jnp.any(moved, axis=0, keepdims=True)       # [1, Ct]
     dest = jnp.sum(jnp.where(moved, new_c, 0), axis=0,
@@ -423,9 +441,10 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     """Partition one wave + build its smaller-child histograms in ONE
     data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]).
 
-    tbl: [9, W] int32 packed split table (TBL_* rows). g/h must be
-    pre-masked by sample_mask; counts use the mask channel. Only the
-    feature-major bins are read — the partition selects feature rows.
+    tbl: [18, W] int32 packed split table (TBL_* rows: 10 scalar
+    fields + 8 categorical bitset words). g/h must be pre-masked by
+    sample_mask; counts use the mask channel. Only the feature-major
+    bins are read — the partition selects feature rows.
     """
     F, n = bins_t.shape
     W = int(tbl.shape[1])

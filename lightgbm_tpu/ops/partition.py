@@ -7,31 +7,55 @@ with per-leaf (begin, count) ranges; on TPU we keep a flat ``leaf_ids[N]``
 assignment updated by a masked elementwise select — shape-static, no
 host round trip, and directly usable to scatter leaf outputs into the
 score vector.
+
+Categorical splits carry a bin-space bitset (set bit = bin goes LEFT,
+src/io/dense_bin.hpp SplitCategorical semantics): membership is an
+8-way word select + bit test, all lane-parallel.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .split import MISSING_NAN, MISSING_ZERO
+from .split import MISSING_NAN, MISSING_ZERO, NCAT_WORDS
+
+
+def cat_bit_left(bin_col, cat_words):
+    """True where the bin's bit is set in the left-set bitset.
+
+    bin_col: [N] int32; cat_words: [NCAT_WORDS] int32.
+    """
+    widx = jnp.right_shift(bin_col, 5)
+    word = jnp.zeros_like(bin_col)
+    for k in range(NCAT_WORDS):
+        word = jnp.where(widx == k, cat_words[k], word)
+    bit = jnp.bitwise_and(
+        jnp.right_shift(word, jnp.bitwise_and(bin_col, 31)), 1)
+    return bit != 0
 
 
 def row_goes_right(bin_col, threshold_bin, default_left, missing_type,
-                   default_bin, num_bin):
+                   default_bin, num_bin, is_cat=False, cat_words=None):
     """Binned decision for one split (dense_bin.hpp Split semantics).
 
     - missing NaN  -> rows in the NaN bin (num_bin-1) go to the default side
     - missing Zero -> rows in the default(zero) bin go to the default side
     - otherwise    -> bin <= threshold goes left
+    - categorical  -> bin's bit set in ``cat_words`` goes left; unseen
+      and NaN bins have no bit and go right (dense_bin.hpp:SplitCat)
     """
     is_missing = (((missing_type == MISSING_NAN) & (bin_col == num_bin - 1))
                   | ((missing_type == MISSING_ZERO) & (bin_col == default_bin)))
     base_right = bin_col > threshold_bin
-    return jnp.where(is_missing, ~default_left, base_right)
+    right = jnp.where(is_missing, ~default_left, base_right)
+    if cat_words is not None:
+        right = jnp.where(is_cat, ~cat_bit_left(bin_col, cat_words),
+                          right)
+    return right
 
 
 def apply_split(leaf_ids, bin_col, leaf, new_leaf, threshold_bin,
                 default_left, missing_type, default_bin, num_bin,
-                enabled=True):
+                enabled=True, is_cat=False, cat_words=None):
     """Send the split leaf's right-side rows to ``new_leaf``.
 
     Left child keeps the parent's leaf index, right child takes the new
@@ -39,6 +63,7 @@ def apply_split(leaf_ids, bin_col, leaf, new_leaf, threshold_bin,
     keeps ``leaf``, right becomes ``num_leaves_``).
     """
     right = row_goes_right(bin_col, threshold_bin, default_left,
-                           missing_type, default_bin, num_bin)
+                           missing_type, default_bin, num_bin,
+                           is_cat=is_cat, cat_words=cat_words)
     move = (leaf_ids == leaf) & right & enabled
     return jnp.where(move, new_leaf, leaf_ids)

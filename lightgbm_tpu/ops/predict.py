@@ -39,7 +39,8 @@ def replay_partition(rec, bins_t, meta: FeatureMeta):
             leaf_ids, bin_col, rec.split_leaf[i], i + 1, rec.split_bin[i],
             rec.split_default_left[i], meta.missing_type[safe_feat],
             meta.default_bin[safe_feat], meta.num_bin[safe_feat],
-            enabled=enabled)
+            enabled=enabled, is_cat=rec.split_is_cat[i],
+            cat_words=rec.split_cat_words[i])
 
     return jax.lax.fori_loop(0, num_splits, body, leaf_ids)
 

@@ -38,6 +38,8 @@ MISSING_NONE = 0
 MISSING_ZERO = 1
 MISSING_NAN = 2
 
+NCAT_WORDS = 8              # 256-bin bitset for categorical left-sets
+
 
 class SplitParams(NamedTuple):
     """Static (per-training-run) split hyperparameters."""
@@ -47,6 +49,15 @@ class SplitParams(NamedTuple):
     min_data_in_leaf: float = 20.0
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
+    # categorical search (feature_histogram.hpp:112-234)
+    max_cat_to_onehot: int = 4
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    min_data_per_group: float = 100.0
+    # static trace-time gate: False compiles the categorical search out
+    # entirely (set per-dataset; numerical-only runs pay nothing)
+    has_cat: bool = True
 
 
 class FeatureMeta(NamedTuple):
@@ -56,6 +67,9 @@ class FeatureMeta(NamedTuple):
     default_bin: jax.Array   # [F] int32
     monotone: jax.Array      # [F] int32 (-1, 0, +1)
     penalty: jax.Array       # [F] float32 (feature_contri; 1.0 default)
+    # 1 = categorical (bin.h BinType); scalar-0 default broadcasts so
+    # numerical-only constructors don't need the field
+    is_cat: jax.Array = np.zeros((), np.int32)
 
     @classmethod
     def from_mappers(cls, mappers, monotone_constraints=None,
@@ -73,12 +87,15 @@ class FeatureMeta(NamedTuple):
             default_bin=np.array([m.default_bin for m in mappers], np.int32),
             monotone=mono,
             penalty=pen,
+            is_cat=np.array([1 if m.bin_type == 1 else 0
+                             for m in mappers], np.int32),
         )
 
 
 class SplitResult(NamedTuple):
-    """Best split for one leaf — all scalars (SplitInfo analog,
-    src/treelearner/split_info.hpp:17)."""
+    """Best split for one leaf — all scalars except the categorical
+    left-set bitset (SplitInfo analog, src/treelearner/split_info.hpp:17;
+    cat_threshold split_info.hpp:28)."""
     gain: jax.Array
     feature: jax.Array
     threshold_bin: jax.Array
@@ -91,6 +108,9 @@ class SplitResult(NamedTuple):
     left_sum_h: jax.Array
     right_sum_g: jax.Array
     right_sum_h: jax.Array
+    is_cat: jax.Array = np.zeros((), bool)
+    # [NCAT_WORDS] int32 bitset over BIN ids: set bit = bin goes LEFT
+    cat_words: jax.Array = np.zeros(NCAT_WORDS, np.int32)
 
 
 def threshold_l1(s, l1):
@@ -203,7 +223,8 @@ def _candidate_tables(hist: jax.Array, sum_g, sum_h, num_data,
     gains2 = side_gains(l_g2, l_h2, r_g2, r_h2)
     ok2 = valid2 & constraints(l_c2, l_h2, r_c2, r_h2) & (gains2 > min_gain_shift)
 
-    fmask = feature_mask[:, None] & can_split
+    ic = jnp.broadcast_to(jnp.asarray(meta.is_cat, jnp.int32), (F,)) > 0
+    fmask = feature_mask[:, None] & can_split & ~ic[:, None]
     g1 = jnp.where(ok1 & fmask, gains1, KMIN_SCORE)
     g2 = jnp.where(ok2 & fmask, gains2, KMIN_SCORE)
     ctx = dict(l_g1=l_g1, l_h1=l_h1, l_c1=l_c1,
@@ -213,15 +234,168 @@ def _candidate_tables(hist: jax.Array, sum_g, sum_h, num_data,
     return g2, g1, min_gain_shift, ctx
 
 
+def _categorical_tables(hist: jax.Array, sum_g, sum_h2, num_data,
+                        feature_mask, meta: FeatureMeta, hp: SplitParams,
+                        can_split, min_gain_shift):
+    """Categorical split candidates (FindBestThresholdCategorical,
+    feature_histogram.hpp:112-234), fully vectorized.
+
+    Returns (gc1, gc2, cat_ctx): gc1 = dir=+1 sorted-prefix gains (and
+    the one-hot gains for small-cardinality features), gc2 = dir=-1,
+    both [F, B] with -inf where invalid. A feature is one-hot when
+    ``num_bin <= max_cat_to_onehot``; otherwise bins with
+    ``count >= cat_smooth`` are sorted by g/(h + cat_smooth) and
+    prefixes of up to ``max_cat_threshold`` bins are candidates, with
+    ``min_data_per_group`` chunking between emitted candidates.
+    """
+    f32 = jnp.float32
+    F, B, _ = hist.shape
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    c = hist[:, :, 2]
+    nb = meta.num_bin.astype(jnp.int32)
+    mt = meta.missing_type.astype(jnp.int32)
+    ic = jnp.broadcast_to(jnp.asarray(meta.is_cat, jnp.int32), (F,)) > 0
+    bidx = jnp.arange(B, dtype=jnp.int32)[None, :]
+
+    l1 = f32(hp.lambda_l1)
+    l2c = f32(hp.lambda_l2 + hp.cat_l2)
+    l2n = f32(hp.lambda_l2)
+    mds = float(hp.max_delta_step)
+    mdl = f32(hp.min_data_in_leaf)
+    msh = f32(hp.min_sum_hessian_in_leaf)
+    mdpg = f32(hp.min_data_per_group)
+
+    # candidate category bins (hpp:125-126: the trailing missing bin is
+    # excluded unless the feature is "full" / MissingType::None)
+    used_bin = nb - 1 + (mt == MISSING_NONE).astype(jnp.int32)  # [F]
+    bin_ok = bidx < used_bin[:, None]
+
+    def pair_gain(lg, lh, rg, rh, l2):
+        return (leaf_split_gain(lg, lh, l1, l2, mds)
+                + leaf_split_gain(rg, rh, l1, l2, mds))
+
+    use_onehot = nb <= hp.max_cat_to_onehot                      # [F]
+    fmask = feature_mask & can_split
+
+    # ---- one-hot: left = single bin t (hpp:133-163, plain l2) ----
+    lg_o, lh_o, lc_o = g, h + KEPSILON, c
+    rg_o = sum_g - g
+    rh_o = sum_h2 - lh_o
+    rc_o = num_data - c
+    gain_o = pair_gain(lg_o, lh_o, rg_o, rh_o, l2n)
+    ok_o = (bin_ok & (c >= mdl) & (h >= msh) & (rc_o >= mdl)
+            & (rh_o >= msh) & (gain_o > min_gain_shift)
+            & ic[:, None] & use_onehot[:, None] & fmask[:, None])
+    gain_o = jnp.where(ok_o, gain_o, KMIN_SCORE)
+
+    # ---- sorted k-vs-rest (hpp:164-234, l2 + cat_l2) ----
+    elig = bin_ok & (c >= f32(hp.cat_smooth))      # hpp:166 count gate
+    ratio = g / (h + f32(hp.cat_smooth))
+    ratio = jnp.where(elig, ratio, jnp.inf)        # ineligible sort last
+    order = jnp.argsort(ratio, axis=1)             # [F, B]
+    rank = jnp.argsort(order, axis=1)              # bin -> sorted pos
+    used = jnp.sum(elig.astype(jnp.int32), axis=1)  # [F]
+    pos = jnp.arange(B, dtype=jnp.int32)[None, :]
+    in_use = pos < used[:, None]
+
+    def sorted_of(x):
+        return jnp.where(in_use, jnp.take_along_axis(x, order, axis=1),
+                         0.0)
+    gs, hs, cs = sorted_of(g), sorted_of(h), sorted_of(c)
+
+    max_num_cat = jnp.minimum(hp.max_cat_threshold,
+                              (used + 1) // 2)[:, None]          # [F,1]
+
+    def direction(gd, hd, cd):
+        """Candidates for one scan direction over pre-sorted arrays."""
+        lg = jnp.cumsum(gd, axis=1)
+        lh = jnp.cumsum(hd, axis=1) + KEPSILON
+        lc = jnp.cumsum(cd, axis=1)
+        rg = sum_g - lg
+        rh = sum_h2 - lh
+        rc = num_data - lc
+        left_ok = (lc >= mdl) & (lh >= msh)
+        # right-side failures BREAK the reference scan; both quantities
+        # shrink monotonically with i, so the break is a prefix mask
+        right_ok = (rc >= mdl) & (rc >= mdpg) & (rh >= msh)
+        right_ok = jnp.cumprod(right_ok.astype(jnp.int32),
+                               axis=1).astype(bool)
+        # min_data_per_group chunking: accumulate counts, emit when the
+        # current group reaches mdpg AND the left checks pass, reset on
+        # emission (hpp:196-216)
+        def step(cnt, xs):
+            cn, lok = xs
+            cnt = cnt + cn
+            emit = lok & (cnt >= mdpg)
+            return jnp.where(emit, 0.0, cnt), emit
+        _, emits = jax.lax.scan(step, jnp.zeros(F, f32),
+                                (cd.T, left_ok.T))
+        emit = emits.T
+        gain = pair_gain(lg, lh, rg, rh, l2c)
+        ok = (emit & right_ok & in_use & (pos < max_num_cat)
+              & (gain > min_gain_shift)
+              & ic[:, None] & ~use_onehot[:, None] & fmask[:, None])
+        return jnp.where(ok, gain, KMIN_SCORE), lg, lh, lc
+
+    gain_p, lg_p, lh_p, lc_p = direction(gs, hs, cs)
+    # dir=-1 scans from the LAST eligible position backwards: reverse
+    # the eligible block (positions used-1..0). Reversing the masked
+    # arrays then re-masking keeps ineligible tail at zero.
+    def rev_use(x):
+        full = jnp.take_along_axis(
+            x, jnp.clip(used[:, None] - 1 - pos, 0, B - 1), axis=1)
+        return jnp.where(in_use, full, 0.0)
+    gain_m, lg_m, lh_m, lc_m = direction(rev_use(gs), rev_use(hs),
+                                         rev_use(cs))
+
+    # one-hot candidates ride the dir=+1 table (a feature is in exactly
+    # one mode, so the slots never collide)
+    gc1 = jnp.maximum(gain_p, gain_o)
+    gc2 = gain_m
+    ctx = dict(order=order, rank=rank, used=used, elig=elig,
+               use_onehot=use_onehot,
+               lg_o=lg_o, lh_o=lh_o, lc_o=lc_o,
+               lg_p=lg_p, lh_p=lh_p, lc_p=lc_p,
+               lg_m=lg_m, lh_m=lh_m, lc_m=lc_m, l2c=l2c, l2n=l2n)
+    return gc1, gc2, ctx
+
+
+def _cat_left_bitset(fi, t, is_p1, ctx, B):
+    """Left-set bitset [NCAT_WORDS] for the winning categorical split."""
+    onehot = ctx["use_onehot"][fi]
+    rank = ctx["rank"][fi]                 # [B] bin -> sorted pos
+    used = ctx["used"][fi]
+    elig = ctx["elig"][fi]
+    bidx = jnp.arange(B, dtype=jnp.int32)
+    member_oh = bidx == t
+    member_p1 = (rank <= t) & elig
+    member_m1 = (rank >= used - 1 - t) & elig
+    member = jnp.where(onehot, member_oh,
+                       jnp.where(is_p1, member_p1, member_m1))
+    word = bidx // 32
+    bit = jnp.left_shift(jnp.uint32(1), (bidx % 32).astype(jnp.uint32))
+    contrib = jnp.where(member, bit, jnp.uint32(0))
+    words = jnp.zeros(NCAT_WORDS, jnp.uint32).at[word].add(
+        contrib, mode="drop")
+    return words.astype(jnp.int32)
+
+
 def best_gain_per_feature(hist, sum_g, sum_h, num_data, feature_mask,
                           meta: FeatureMeta, hp: SplitParams,
                           can_split=True) -> jax.Array:
     """Per-feature best split gain [F] (-inf where no valid split) — the
     local-vote input of the voting-parallel learner
     (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp:166)."""
-    g2, g1, min_gain_shift, _ = _candidate_tables(
+    g2, g1, min_gain_shift, ctx = _candidate_tables(
         hist, sum_g, sum_h, num_data, feature_mask, meta, hp, can_split)
     best = jnp.maximum(g2.max(axis=1), g1.max(axis=1))
+    if hp.has_cat:
+        gc1, gc2, _ = _categorical_tables(
+            hist, ctx["sum_g"], ctx["sum_h2"], ctx["num_data"],
+            feature_mask, meta, hp, can_split, min_gain_shift)
+        best = jnp.maximum(best,
+                           jnp.maximum(gc1.max(axis=1), gc2.max(axis=1)))
     return jnp.where(jnp.isfinite(best),
                      (best - min_gain_shift) * meta.penalty, KMIN_SCORE)
 
@@ -241,34 +415,70 @@ def find_best_split(hist: jax.Array, sum_g, sum_h, num_data,
     F, B, _ = hist.shape
     g2, g1, min_gain_shift, ctx = _candidate_tables(
         hist, sum_g, sum_h, num_data, feature_mask, meta, hp, can_split)
+    if hp.has_cat:
+        gc1, gc2, cctx = _categorical_tables(
+            hist, ctx["sum_g"], ctx["sum_h2"], ctx["num_data"],
+            feature_mask, meta, hp, can_split, min_gain_shift)
+    else:
+        gc1 = gc2 = jnp.full((F, B), KMIN_SCORE)
+        cctx = None
 
     # --- argmax with reference tie-break order --------------------------
-    # flatten [F, 2, B]: dir=-1 first with REVERSED thresholds (so larger t
-    # wins ties), then dir=+1 ascending. argmax returns first max.
-    cand = jnp.stack([g2[:, ::-1], g1], axis=1)     # [F, 2, B]
+    # flatten [F, 4, B]: numerical dir=-1 first with REVERSED thresholds
+    # (so larger t wins ties), numerical dir=+1 ascending, then the
+    # categorical dir=+1 / dir=-1 candidate tables (a feature is either
+    # numerical or categorical, so the blocks never compete within one
+    # feature). argmax returns the first max.
+    cand = jnp.stack([g2[:, ::-1], g1, gc1, gc2], axis=1)  # [F, 4, B]
     flat = cand.reshape(-1)
     idx = jnp.argmax(flat)
     best_gain = flat[idx]
-    fi = idx // (2 * B)
-    rem = idx % (2 * B)
-    d = rem // B                                     # 0 -> dir=-1, 1 -> dir=+1
+    fi = idx // (4 * B)
+    rem = idx % (4 * B)
+    d = rem // B                  # 0 num dir=-1, 1 num dir=+1, 2/3 cat
     tb = rem % B
     t = jnp.where(d == 0, B - 1 - tb, tb)            # undo reversal
 
     is_dir2 = d == 0
+    is_cat = d >= 2
+    cat_p1 = d == 2
     lg = jnp.where(is_dir2, ctx["l_g2"][fi, t], ctx["l_g1"][fi, t])
     lh = jnp.where(is_dir2, ctx["l_h2"][fi, t], ctx["l_h1"][fi, t])
     lc = jnp.where(is_dir2, ctx["l_c2"][fi, t], ctx["l_c1"][fi, t])
     sum_g = ctx["sum_g"]
     sum_h2 = ctx["sum_h2"]
     l1, l2, mds = ctx["l1"], ctx["l2"], ctx["mds"]
+    l2_eff = l2
+    if hp.has_cat:
+        # categorical left sums: one-hot rides the dir=+1 slot
+        onehot = cctx["use_onehot"][fi]
+        lg_c = jnp.where(cat_p1,
+                         jnp.where(onehot, cctx["lg_o"][fi, t],
+                                   cctx["lg_p"][fi, t]),
+                         cctx["lg_m"][fi, t])
+        lh_c = jnp.where(cat_p1,
+                         jnp.where(onehot, cctx["lh_o"][fi, t],
+                                   cctx["lh_p"][fi, t]),
+                         cctx["lh_m"][fi, t])
+        lc_c = jnp.where(cat_p1,
+                         jnp.where(onehot, cctx["lc_o"][fi, t],
+                                   cctx["lc_p"][fi, t]),
+                         cctx["lc_m"][fi, t])
+        lg = jnp.where(is_cat, lg_c, lg)
+        lh = jnp.where(is_cat, lh_c, lh)
+        lc = jnp.where(is_cat, lc_c, lc)
+        # categorical sorted mode uses l2 + cat_l2 (hpp:233-246)
+        l2_eff = jnp.where(is_cat & ~onehot, cctx["l2c"], l2)
+        cat_words = _cat_left_bitset(fi, t, cat_p1, cctx, B)
+    else:
+        cat_words = jnp.zeros(NCAT_WORDS, jnp.int32)
     rg = sum_g - lg
     rh = sum_h2 - lh
     rc = ctx["num_data"] - lc
 
     # single-scan NaN edge: report default_left = False (hpp:103-106)
     single_nan = (~ctx["two_scan"][fi]) & (ctx["mt"][fi] == MISSING_NAN)
-    default_left = is_dir2 & ~single_nan
+    default_left = is_dir2 & ~single_nan & ~is_cat
 
     has = jnp.isfinite(best_gain)
     out = SplitResult(
@@ -277,13 +487,16 @@ def find_best_split(hist: jax.Array, sum_g, sum_h, num_data,
         feature=jnp.where(has, fi, -1).astype(jnp.int32),
         threshold_bin=jnp.where(has, t, 0).astype(jnp.int32),
         default_left=default_left & has,
-        left_output=calculate_leaf_output(lg, lh, l1, l2, mds),
-        right_output=calculate_leaf_output(rg, rh, l1, l2, mds),
+        left_output=calculate_leaf_output(lg, lh, l1, l2_eff, mds),
+        right_output=calculate_leaf_output(rg, rh, l1, l2_eff, mds),
         left_count=lc,
         right_count=rc,
         left_sum_g=lg,
         left_sum_h=lh - KEPSILON,    # hpp: stores sum - kEpsilon
         right_sum_g=rg,
         right_sum_h=rh - KEPSILON,
+        is_cat=is_cat & has,
+        cat_words=jnp.where(is_cat & has, cat_words,
+                            jnp.zeros(NCAT_WORDS, jnp.int32)),
     )
     return out

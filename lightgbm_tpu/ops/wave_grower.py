@@ -80,6 +80,8 @@ class _State(NamedTuple):
     t_left_sum_h: jax.Array
     t_right_sum_g: jax.Array
     t_right_sum_h: jax.Array
+    t_is_cat: jax.Array        # [L] bool
+    t_cat_words: jax.Array     # [L, 8] int32 left-set bin bitset
     # per-leaf aggregates
     leaf_output: jax.Array
     leaf_count: jax.Array
@@ -168,9 +170,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
 
     if partition_fn is None:
         def partition_fn(bins_t, leaf_ids, wl, new_ids, feat, tbin,
-                         dleft, active):
+                         dleft, active, iscat=None, catw=None):
             return apply_wave_splits(bins_t, leaf_ids, wl, new_ids, feat,
-                                     tbin, dleft, active, meta)
+                                     tbin, dleft, active, meta,
+                                     iscat, catw)
 
     if reduce_fn is None:
         def reduce_fn(x):
@@ -236,6 +239,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             t_left_sum_h=set0(jnp.zeros(L, f32), root_split.left_sum_h),
             t_right_sum_g=set0(jnp.zeros(L, f32), root_split.right_sum_g),
             t_right_sum_h=set0(jnp.zeros(L, f32), root_split.right_sum_h),
+            t_is_cat=set0(jnp.zeros(L, bool), root_split.is_cat),
+            t_cat_words=jnp.zeros((L, 8), jnp.int32).at[0].set(
+                root_split.cat_words[0] if root_split.cat_words.ndim > 1
+                else root_split.cat_words),
             leaf_output=jnp.zeros(L, f32),
             leaf_count=jnp.zeros(L, f32).at[0].set(root_c),
             leaf_sum_g=jnp.zeros(L, f32).at[0].set(root_g),
@@ -257,6 +264,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 leaf_sum_h=jnp.zeros(L, f32),
                 internal_value=jnp.zeros(L - 1, f32),
                 internal_count=jnp.zeros(L - 1, f32),
+                split_is_cat=jnp.zeros(L - 1, bool),
+                split_cat_words=jnp.zeros((L - 1, 8), jnp.int32),
             ),
         )
 
@@ -282,6 +291,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             feat = state.t_feature[wl]
             tbin = state.t_bin[wl]
             dleft = state.t_default_left[wl]
+            iscat = state.t_is_cat[wl]
+            catw = state.t_cat_words[wl]               # [W, 8]
             lcnt = state.t_left_count[wl]
             rcnt = state.t_right_count[wl]
             lg, lh = state.t_left_sum_g[wl], state.t_left_sum_h[wl]
@@ -298,23 +309,24 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             small_ids = jnp.where(active, small_ids, -1)
             if use_fused:
                 safe_feat = jnp.maximum(feat, 0)
-                tbl = jnp.stack([
+                tbl = jnp.concatenate([jnp.stack([
                     wl, new_ids, safe_feat, tbin,
                     dleft.astype(jnp.int32),
                     meta.missing_type[safe_feat],
                     meta.default_bin[safe_feat],
-                    meta.num_bin[safe_feat], small_ids])
+                    meta.num_bin[safe_feat], small_ids,
+                    iscat.astype(jnp.int32)]), catw.T])      # [18, W]
                 leaf_ids, hist_small = fused_partition_histogram_pallas(
                     bins_t, grad, hess, sample_mask,
                     state.leaf_ids, tbl, num_bins=B,
-                    chunk=cfg.chunk or 2048, interpret=fused_interpret,
+                    chunk=cfg.chunk or 8192, interpret=fused_interpret,
                     precision=cfg.precision)
                 # out-of-bag rows partition too; their g/h are pre-masked
                 # and the count channel rides on sample_mask
             else:
                 leaf_ids = partition_fn(bins_t, state.leaf_ids, wl,
                                         new_ids, feat, tbin, dleft,
-                                        active)
+                                        active, iscat, catw)
                 hist_small = hist_fn(bins_t, grad, hess,
                                      bag_mask_ids(leaf_ids), small_ids)
             parent_hist = state.hist[wl]                 # [W, F, B, 3]
@@ -342,6 +354,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     jnp.where(active, top_gain, 0.0), mode="drop"),
                 split_default_left=rec.split_default_left.at[pos].set(
                     dleft, mode="drop"),
+                split_is_cat=rec.split_is_cat.at[pos].set(
+                    iscat, mode="drop"),
+                split_cat_words=rec.split_cat_words.at[pos].set(
+                    catw, mode="drop"),
                 internal_value=rec.internal_value.at[pos].set(
                     parent_out, mode="drop"),
                 internal_count=rec.internal_count.at[pos].set(
@@ -389,6 +405,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 t_left_sum_h=st(state.t_left_sum_h, res.left_sum_h),
                 t_right_sum_g=st(state.t_right_sum_g, res.right_sum_g),
                 t_right_sum_h=st(state.t_right_sum_h, res.right_sum_h),
+                t_is_cat=st(state.t_is_cat, res.is_cat),
+                t_cat_words=st(state.t_cat_words, res.cat_words),
                 leaf_output=leaf_output,
                 leaf_count=leaf_count,
                 leaf_sum_g=leaf_sum_g,
@@ -414,13 +432,14 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
 
 
 def apply_wave_splits(bins_t, leaf_ids, wl, new_ids, feat, tbin, dleft,
-                      active, meta: FeatureMeta):
+                      active, meta: FeatureMeta, iscat=None, catw=None):
     """Apply up to W splits to the row partition in one fused pass.
 
     For each wave slot k: rows with ``leaf_ids == wl[k]`` whose binned
     feature value goes right move to ``new_ids[k]``
     (DataPartition::Split + Bin::Split semantics,
-    src/treelearner/data_partition.hpp:109-166).
+    src/treelearner/data_partition.hpp:109-166). ``iscat``/``catw``
+    carry per-slot categorical flags + left-set bitsets.
     """
     W = wl.shape[0]
     out = leaf_ids
@@ -429,7 +448,9 @@ def apply_wave_splits(bins_t, leaf_ids, wl, new_ids, feat, tbin, dleft,
         right = row_goes_right(
             col.astype(jnp.int32), tbin[k], dleft[k],
             meta.missing_type[feat[k]], meta.default_bin[feat[k]],
-            meta.num_bin[feat[k]])
+            meta.num_bin[feat[k]],
+            is_cat=(False if iscat is None else iscat[k]),
+            cat_words=(None if catw is None else catw[k]))
         move = (leaf_ids == wl[k]) & right & active[k]
         out = jnp.where(move, new_ids[k], out)
     return out

@@ -1,0 +1,102 @@
+"""C-API shim + fork cache-admission driver tests.
+
+Covers the LGBM_* surface (reference: src/c_api.cpp:47-1568) and the
+windowed LRB retraining loop (reference: src/test.cpp:97-341).
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu import capi
+from lightgbm_tpu.lrb import LrbDriver, synthetic_trace
+
+
+def _data(n=300, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+class TestCApi:
+    def test_train_predict_save_cycle(self, tmp_path):
+        X, y = _data()
+        params = "objective=binary num_leaves=15 min_data_in_leaf=5 verbose=-1"
+        ds = capi.LGBM_DatasetCreateFromMat(X, parameters=params)
+        capi.LGBM_DatasetSetField(ds, "label", y)
+        assert capi.LGBM_DatasetGetNumData(ds) == 300
+        assert capi.LGBM_DatasetGetNumFeature(ds) == 6
+        bst = capi.LGBM_BoosterCreate(ds, params)
+        for _ in range(10):
+            if capi.LGBM_BoosterUpdateOneIter(bst):
+                break
+        assert capi.LGBM_BoosterGetCurrentIteration(bst) == 10
+        pred = capi.LGBM_BoosterPredictForMat(bst, X)
+        assert ((np.asarray(pred) > 0.5) == y).mean() > 0.9
+        path = str(tmp_path / "m.txt")
+        capi.LGBM_BoosterSaveModel(bst, filename=path)
+        loaded = capi.LGBM_BoosterCreateFromModelfile(path)
+        p2 = capi.LGBM_BoosterPredictForMat(loaded, X)
+        np.testing.assert_allclose(p2, pred, atol=1e-5)
+        imp = capi.LGBM_BoosterFeatureImportance(bst)
+        assert imp.sum() > 0
+
+    def test_csr_paths(self):
+        X, y = _data(n=200)
+        import scipy.sparse as sp
+        S = sp.csr_matrix(X)
+        params = "objective=binary num_leaves=7 min_data_in_leaf=5 verbose=-1"
+        ds = capi.LGBM_DatasetCreateFromCSR(
+            S.indptr, capi.C_API_DTYPE_INT32, S.indices, S.data,
+            capi.C_API_DTYPE_FLOAT64, len(S.indptr), S.nnz, X.shape[1],
+            parameters=params)
+        capi.LGBM_DatasetSetField(ds, "label", y)
+        bst = capi.LGBM_BoosterCreate(ds, params)
+        for _ in range(5):
+            capi.LGBM_BoosterUpdateOneIter(bst)
+        pred = capi.LGBM_BoosterPredictForCSR(
+            bst, S.indptr, capi.C_API_DTYPE_INT32, S.indices, S.data,
+            capi.C_API_DTYPE_FLOAT64, len(S.indptr), S.nnz, X.shape[1])
+        dense_pred = capi.LGBM_BoosterPredictForMat(bst, X)
+        np.testing.assert_allclose(pred, dense_pred, atol=1e-6)
+
+    def test_custom_objective_and_eval(self):
+        X, y = _data(n=200)
+        params = ("objective=binary num_leaves=7 min_data_in_leaf=5 "
+                  "verbose=-1 is_provide_training_metric=true "
+                  "metric=binary_logloss")
+        ds = capi.LGBM_DatasetCreateFromMat(X, parameters=params)
+        capi.LGBM_DatasetSetField(ds, "label", y)
+        bst = capi.LGBM_BoosterCreate(ds, params)
+        capi.LGBM_BoosterUpdateOneIter(bst)
+        evals = capi.LGBM_BoosterGetEval(bst, 0)
+        assert evals and evals[0][0] == "binary_logloss"
+        # custom gradients
+        raw = np.asarray(capi.LGBM_BoosterPredictForMat(
+            bst, X, predict_type=capi.C_API_PREDICT_RAW_SCORE))
+        p = 1 / (1 + np.exp(-raw))
+        capi.LGBM_BoosterUpdateOneIterCustom(bst, (p - y), p * (1 - p))
+        assert capi.LGBM_BoosterGetCurrentIteration(bst) == 2
+        capi.LGBM_BoosterRollbackOneIter(bst)
+        assert capi.LGBM_BoosterGetCurrentIteration(bst) == 1
+
+
+class TestLrbDriver:
+    def test_windowed_retraining(self):
+        """The fork's end-to-end loop on a synthetic zipf trace:
+        per-window OPT labels, fresh boosters, FP/FN eval output
+        (test.cpp:300-341)."""
+        driver = LrbDriver(cache_size=1 << 16, window_size=500,
+                           sample_size=400, cutoff=0.5, sampling=1,
+                           result_file=open("/dev/null", "w"))
+        for seq, oid, size, cost in synthetic_trace(1500):
+            driver.process_request(seq, oid, size, cost)
+        assert driver.window_index == 3
+        assert driver.booster is not None
+        r1, r2, r3 = driver.results
+        # OPT labeled something cacheable in every window
+        assert all(r["opt_obj_hit_ratio"] > 0 for r in driver.results)
+        # windows after the first evaluate the previous model
+        assert "fp_rate" in r2 and "fn_rate" in r2
+        assert 0 <= r2["fp_rate"] <= 1 and 0 <= r2["fn_rate"] <= 1
+        # the learned admission policy beats chance: error rates bounded
+        assert r3["fp_rate"] + r3["fn_rate"] < 0.9
