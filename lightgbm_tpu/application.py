@@ -91,7 +91,7 @@ class Application:
         elif task == "convert_model":
             self.convert_model()
         elif task == "refit":
-            log.fatal("Task refit is not supported yet")
+            self.refit()
         else:
             log.fatal(f"Unknown task: {task}")
 
@@ -131,6 +131,26 @@ class Application:
                                    os.path.basename(vpath))
         booster.train(cfg.snapshot_freq, cfg.output_model)
 
+    def refit(self) -> None:
+        """Task refit: re-learn input_model's leaf values on `data`
+        (application.cpp task=refit -> GBDT::RefitTree)."""
+        cfg = self.config
+        model_path = _rel_to_config(cfg, cfg.input_model)
+        if not model_path or not os.path.isfile(model_path):
+            log.fatal("refit requires input_model")
+        loader = DatasetLoader(cfg)
+        train_data = loader.load_from_file(_rel_to_config(cfg, cfg.data))
+        objective = create_objective(cfg.objective, cfg)
+        if objective is not None:
+            objective.init(train_data.metadata, train_data.num_data)
+        booster = create_boosting(cfg.boosting_type())
+        with open(model_path) as fh:
+            booster.load_model_from_string(fh.read())
+        booster.init_from_loaded(cfg, train_data, objective, [])
+        booster.refit_existing()
+        booster.save_model_to_file(cfg.output_model)
+        log.info("Refit model saved to %s", cfg.output_model)
+
     # -- predict (application.cpp:234-249) ----------------------------------
 
     def predict(self) -> None:
@@ -152,9 +172,15 @@ class Application:
         elif cfg.predict_contrib:
             out = booster.predict_contrib(X, n_iter)
         elif cfg.predict_raw_score:
-            out = booster.predict_raw(X, n_iter)
+            out = booster.predict_raw(
+                X, n_iter, pred_early_stop=cfg.pred_early_stop,
+                pred_early_stop_freq=cfg.pred_early_stop_freq,
+                pred_early_stop_margin=cfg.pred_early_stop_margin)
         else:
-            out = booster.predict(X, n_iter)
+            out = booster.predict(
+                X, n_iter, pred_early_stop=cfg.pred_early_stop,
+                pred_early_stop_freq=cfg.pred_early_stop_freq,
+                pred_early_stop_margin=cfg.pred_early_stop_margin)
         out = np.asarray(out)
         out_path = cfg.output_result or "LightGBM_predict_result.txt"
         with open(out_path, "w") as fh:

@@ -608,16 +608,51 @@ class Booster:
             X, _, _ = _data_to_2d(data)
         if num_iteration < 0 and self.best_iteration > 0:
             num_iteration = self.best_iteration
+        pred_kw = {k: v for k, v in kwargs.items()
+                   if k.startswith("pred_early_stop")}
         if pred_leaf:
             return self._gbdt.predict_leaf_index(X, num_iteration)
         if pred_contrib:
             return self._gbdt.predict_contrib(X, num_iteration)
         if raw_score:
-            return self._gbdt.predict_raw(X, num_iteration)
-        return self._gbdt.predict(X, num_iteration)
+            return self._gbdt.predict_raw(X, num_iteration, **pred_kw)
+        return self._gbdt.predict(X, num_iteration, **pred_kw)
 
-    def refit(self, data, label, decay_rate: float = 0.9, **kwargs):
-        raise LightGBMError("refit is not implemented yet")
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit the existing model's leaf values on new data
+        (basic.py Booster.refit -> GBDT::RefitTree)."""
+        from .models.gbdt import GBDT
+        X, _, _ = _data_to_2d(data)
+        y = _label_to_1d(label)
+        cfg = Config()
+        params = dict(self.params)
+        params.pop("refit_decay_rate", None)
+        cfg.set(params)
+        cfg.refit_decay_rate = decay_rate
+        if not params.get("objective") and self._gbdt.objective is not None:
+            cfg.objective = self._gbdt.objective.name
+        model_str = self.model_to_string()
+        new = GBDT()
+        new.load_model_from_string(model_str)
+        # categorical columns are recoverable from the model header:
+        # categorical feature_infos are ':'-joined category lists,
+        # numerical are '[lo:hi]' ranges (io/dataset.py feature_infos)
+        cats = [i for i, info in enumerate(new.feature_infos)
+                if info and info != "none" and not info.startswith("[")]
+        inner = TpuDataset(cfg).construct_from_matrix(
+            X, Metadata(label=y), categorical=cats)
+        objective = create_objective(cfg.objective, cfg)
+        if objective is not None:
+            objective.init(inner.metadata, inner.num_data)
+        new.init_from_loaded(cfg, inner, objective, [])
+        new.refit_existing(decay_rate)
+        out = Booster(model_str=model_str)   # normal ctor: one source
+        out._gbdt = new                      # of truth for attributes
+        out.params = params
+        out.config = cfg
+        out.pandas_categorical = self.pandas_categorical
+        return out
 
     # -- introspection ------------------------------------------------------
 

@@ -333,8 +333,42 @@ class Config:
 
     # -- semantics ----------------------------------------------------------
 
+    # accepted for reference compatibility but not implemented: warn
+    # when set to a non-default value instead of silently ignoring
+    _UNIMPLEMENTED = {
+        "two_round": False,
+        "pre_partition": False,
+        "forcedsplits_filename": "",
+        "convert_model_language": "",
+        "machine_list_filename": "",
+        "machines": "",
+    }
+    # subsumed by the TPU design (documented substitutions, not gaps)
+    _SUBSUMED = {
+        "num_threads": "XLA owns intra-op parallelism",
+        "histogram_pool_size": "histogram pool lives in HBM "
+                               "(preallocated, no LRU needed)",
+        "is_enable_sparse": "dense-only HBM layout by design "
+                            "(io/dataset.py)",
+        "sparse_threshold": "dense-only HBM layout by design",
+        "gpu_platform_id": "device selection is jax's",
+        "gpu_device_id": "device selection is jax's",
+        "gpu_use_dp": "see tpu_use_dp",
+        "local_listen_port": "collectives ride ICI/DCN via XLA",
+        "time_out": "collectives ride ICI/DCN via XLA",
+    }
+
     def check_param_conflict(self) -> None:
         """Config::CheckParamConflict (src/io/config.cpp:202)."""
+        for key, default in self._UNIMPLEMENTED.items():
+            if key in self._raw_params and getattr(self, key) != default:
+                log.warning("Parameter %s is accepted for compatibility "
+                            "but not implemented yet; it has no effect",
+                            key)
+        for key, why in self._SUBSUMED.items():
+            if key in self._raw_params:
+                log.debug("Parameter %s is subsumed by the TPU design: "
+                          "%s", key, why)
         if self.is_provide_training_metric or self.valid:
             if not self.metric:
                 # force defaults from objective later; handled by metric factory

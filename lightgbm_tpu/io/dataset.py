@@ -73,6 +73,12 @@ class TpuDataset:
         self.feature_names: List[str] = []
         self.max_bin_global = 1
         self._reference: Optional["TpuDataset"] = None
+        # EFB state (io/efb.py); None = unbundled
+        self.bundles = None
+        self.bundled_bins: Optional[np.ndarray] = None
+        self.member_bundle: Optional[np.ndarray] = None
+        self.member_offset: Optional[np.ndarray] = None
+        self.bundle_width = 0
 
     # -- construction -------------------------------------------------------
 
@@ -97,6 +103,7 @@ class TpuDataset:
         self.feature_names = (list(feature_names) if feature_names
                               else [f"Column_{i}" for i in range(nf)])
 
+        from ..utils import timing
         if reference is not None:
             # valid set: reuse the train set's mappers (CreateValid)
             self._reference = reference
@@ -107,8 +114,12 @@ class TpuDataset:
             self.feature_names = reference.feature_names
             self.num_total_features = reference.num_total_features
         else:
-            self._construct_mappers(X, set(categorical))
-        self._bin_matrix(X)
+            with timing.phase("binning/find_bins"):
+                self._construct_mappers(X, set(categorical))
+        with timing.phase("binning/bin_matrix"):
+            self._bin_matrix(X)
+        with timing.phase("binning/efb"):
+            self._apply_efb()
         return self
 
     def _construct_mappers(self, X: np.ndarray, categorical: set) -> None:
@@ -161,6 +172,35 @@ class TpuDataset:
             bins[:, i] = self.mappers[i].value_to_bin(X[:, real]).astype(dtype)
         self.bins = bins
 
+    def _apply_efb(self) -> None:
+        """Exclusive feature bundling (Dataset::FindGroups +
+        FastFeatureBundling, dataset.cpp:66-210) — see io/efb.py."""
+        from .efb import bundle_bins, find_bundles
+        cfg = self.config
+        if self._reference is not None:
+            ref = self._reference
+            if ref.bundles is None:
+                return
+            self.bundles = ref.bundles
+            db = np.array([m.default_bin for m in self.mappers], np.int32)
+            nb = np.array([m.num_bin for m in self.mappers], np.int32)
+            self.bundled_bins, self.member_bundle, self.member_offset, \
+                self.bundle_width = bundle_bins(
+                    self.bins, ref.bundles, db, nb)
+            return
+        if not cfg.enable_bundle or self.num_features <= 1:
+            return
+        db = np.array([m.default_bin for m in self.mappers], np.int32)
+        nb = np.array([m.num_bin for m in self.mappers], np.int32)
+        bundles = find_bundles(self.bins, db, nb, cfg.max_conflict_rate)
+        if len(bundles) >= self.num_features:
+            return                       # nothing bundled
+        self.bundles = bundles
+        self.bundled_bins, self.member_bundle, self.member_offset, \
+            self.bundle_width = bundle_bins(self.bins, bundles, db, nb)
+        log.info("EFB bundled %d features into %d columns",
+                 self.num_features, len(bundles))
+
     # -- views --------------------------------------------------------------
 
     @property
@@ -191,7 +231,11 @@ class TpuDataset:
             for i, real in enumerate(self.used_feature_map):
                 if real < len(self.config.feature_contri):
                     contri[i] = self.config.feature_contri[real]
-        return FeatureMeta.from_mappers(self.mappers, mono, contri)
+        meta = FeatureMeta.from_mappers(self.mappers, mono, contri)
+        if self.bundles is not None:
+            meta = meta._replace(bundle=self.member_bundle,
+                                 offset=self.member_offset)
+        return meta
 
     def feature_infos(self) -> List[str]:
         """Per REAL feature; 'none' for unused (model header parity)."""

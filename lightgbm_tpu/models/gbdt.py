@@ -42,7 +42,7 @@ from ..ops.grower import pack_record, unpack_record
 from ..ops.predict import add_leaf_outputs, replay_partition
 from ..ops.split import SplitParams
 from ..ops.wave_grower import WaveGrowerConfig
-from ..utils import log
+from ..utils import log, timing
 from .tree import Tree, tree_from_record
 
 K_MODEL_VERSION = "v2"     # gbdt.h kModelVersion
@@ -103,13 +103,17 @@ class GBDT:
         self._n = n
         self._meta = train_data.feature_meta()
         self._setup_grower()
-        # feature-major device layout [F, N] (ops/hist_wave.py)
-        bins_t = np.ascontiguousarray(train_data.bins.T)
+        # feature-major device layout [F, N] (ops/hist_wave.py); EFB
+        # bundles share columns (io/efb.py)
+        host_bins = (train_data.bundled_bins if self._use_bundles
+                     else train_data.bins)
+        bins_t = np.ascontiguousarray(host_bins.T)
         if self._pad_rows:
             bins_t = np.pad(bins_t, ((0, 0), (0, self._pad_rows)))
         if self._pad_features:
             bins_t = np.pad(bins_t, ((0, self._pad_features), (0, 0)))
-        self._bins_dev = jnp.asarray(bins_t)
+        with timing.phase("init/upload_bins", block_on=None):
+            self._bins_dev = jnp.asarray(bins_t)
         self._full_mask_dev = jnp.asarray(np.concatenate(
             [np.ones(self._n, np.float32),
              np.zeros(self._pad_rows, np.float32)]))
@@ -163,6 +167,17 @@ class GBDT:
         self._mesh = mesh
         self._learner_mode = mode
         D = mesh.devices.size if mesh is not None else 1
+        # EFB is wired through the serial grower's seams only; parallel
+        # modes train on the unbundled member columns
+        self._use_bundles = (self.train_data.bundles is not None
+                             and mode == "serial")
+        if self.train_data.bundles is not None and not self._use_bundles:
+            log.warning("EFB bundling is only used with "
+                        "tree_learner=serial; training on unbundled "
+                        "columns")
+            self._meta = self._meta._replace(
+                bundle=np.zeros((), np.int32),
+                offset=np.zeros((), np.int32))
 
         f = max(self.train_data.num_features, 1)
         self._pad_rows = 0
@@ -218,8 +233,30 @@ class GBDT:
             hp=hp,
             precision=precision)
         self._grower_cfg = gcfg
+        hist_fn = None
+        if self._use_bundles:
+            # EFB: the wave kernel runs over BUNDLE columns, then member
+            # histograms are reconstructed (io/efb.py docstring)
+            from ..io.efb import expand_bundle_histogram
+            from ..ops.hist_wave import wave_histogram
+            td = self.train_data
+            Bb = max(td.bundle_width, 2)
+            mb = jnp.asarray(td.member_bundle)
+            mo = jnp.asarray(td.member_offset)
+            nb_m = jnp.asarray(meta.num_bin)
+            db_m = jnp.asarray(meta.default_bin)
+            B_out = gcfg.num_bins
+
+            def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
+                bh = wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
+                                    num_bins=Bb, chunk=gcfg.chunk,
+                                    use_pallas=gcfg.use_pallas,
+                                    precision=gcfg.precision)
+                return expand_bundle_histogram(bh, mb, mo, nb_m, db_m,
+                                               B_out)
         self._grower = make_grower_for_mode(
-            mode, gcfg, meta, mesh, self._f_pad, cfg.top_k)
+            mode, gcfg, meta, mesh, self._f_pad, cfg.top_k,
+            hist_fn=hist_fn)
         self._step_key = None       # grower changed: rebuild fused step
 
     def _init_scores(self):
@@ -245,7 +282,11 @@ class GBDT:
         self._valid_scores.append(jnp.asarray(init))
         # replay existing model on the new valid set (bins cached on device
         # once — uploads are cheap, downloads are not)
-        vb = jnp.asarray(np.ascontiguousarray(valid_data.bins.T))
+        v_host = (valid_data.bundled_bins
+                  if (self._use_bundles
+                      and valid_data.bundles is not None)
+                  else valid_data.bins)
+        vb = jnp.asarray(np.ascontiguousarray(v_host.T))
         self._valid_bins_dev.append(vb)
         for t_idx, rec in enumerate(self.records):
             cls = t_idx % self.num_tree_per_iteration
@@ -508,10 +549,12 @@ class GBDT:
             key = jax.random.PRNGKey(self._hook_rng.integers(1, 2**31))
         else:
             key = self._dummy_key
-        self._scores, new_valids, recs = step(
-            self._bins_dev, tuple(self._valid_bins_dev),
-            self._scores, tuple(self._valid_scores), mask, fmask,
-            jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in, key)
+        with timing.phase("train/step_dispatch"):
+            self._scores, new_valids, recs = step(
+                self._bins_dev, tuple(self._valid_bins_dev),
+                self._scores, tuple(self._valid_scores), mask, fmask,
+                jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in,
+                key)
         self._valid_scores = list(new_valids)
         for k, rec in enumerate(recs):
             shrinkage_for_file = self.shrinkage_rate
@@ -646,18 +689,29 @@ class GBDT:
         else:
             scores = self._valid_scores[data_idx - 1]
             metrics = self.valid_metrics[data_idx - 1]
-        raw = np.asarray(scores)
-        for m in metrics:
-            for name, val in m.eval(raw, self.objective):
-                out.append((name, val, m.bigger_is_better))
+        with timing.phase("eval/metrics"):
+            raw = np.asarray(scores)
+            for m in metrics:
+                for name, val in m.eval(raw, self.objective):
+                    out.append((name, val, m.bigger_is_better))
         return out
 
     # -- prediction ---------------------------------------------------------
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
-                    start_iteration: int = 0) -> np.ndarray:
+                    start_iteration: int = 0,
+                    pred_early_stop: bool = False,
+                    pred_early_stop_freq: int = 10,
+                    pred_early_stop_margin: float = 10.0) -> np.ndarray:
         """Raw scores [N] or [N, K]. Device path: bin with train mappers,
-        replay trees on device, ONE download (gbdt_prediction.cpp:9-30)."""
+        replay trees on device, ONE download (gbdt_prediction.cpp:9-30).
+
+        ``pred_early_stop``: stop accumulating trees for rows whose
+        prediction margin exceeds the threshold, re-checked every
+        ``freq`` trees (prediction_early_stop.cpp:20-84: binary margin
+        = 2|raw|, multiclass margin = top1 - top2). Rows stop in
+        batches of ``freq`` — inherently data-dependent, so it runs on
+        the host tree path."""
         X = np.asarray(X, np.float64)
         n = X.shape[0]
         k = self.num_tree_per_iteration
@@ -666,6 +720,38 @@ class GBDT:
         if num_iteration >= 0:
             ntree = min(ntree, (start_iteration + num_iteration) * k)
         first = start_iteration * k
+        # the reference enables early stop only where approximate
+        # predictions are acceptable: binary / multiclass
+        # (NeedAccuratePrediction, prediction_early_stop.cpp)
+        if pred_early_stop and k == 1 and not (
+                self.objective is not None
+                and self.objective.name in ("binary", "multiclassova",
+                                            "cross_entropy")):
+            log.warning("pred_early_stop is only supported for "
+                        "binary/multiclass objectives; ignoring")
+            pred_early_stop = False
+        if pred_early_stop and k >= 1 and ntree > first:
+            self._ensure_host_trees()
+            out = np.zeros((k, n), np.float64)
+            active = np.arange(n)
+            for t_idx in range(first, ntree):
+                cls = t_idx % k
+                out[cls, active] += \
+                    self.models[t_idx].predict(X[active])
+                done_group = ((t_idx - first + 1) % max(
+                    pred_early_stop_freq * k, 1) == 0)
+                if done_group and len(active):
+                    if k == 1:
+                        margin = 2.0 * np.abs(out[0, active])
+                    else:
+                        part = np.sort(out[:, active], axis=0)
+                        margin = part[-1] - part[-2]
+                    active = active[margin <= pred_early_stop_margin]
+                    if not len(active):
+                        break
+            if self.average_output:
+                out /= max((ntree - first) // k, 1)
+            return out[0] if k == 1 else out.T
         if self.train_data is not None and len(self.records) >= ntree:
             bins_dev = jnp.asarray(self._bin_input(X))
             acc = jnp.zeros((k, n), jnp.float32)
@@ -695,17 +781,26 @@ class GBDT:
         return out[0] if k == 1 else out.T
 
     def _bin_input(self, X: np.ndarray) -> np.ndarray:
-        """Bin raw rows with the train mappers -> [F, N] feature-major."""
+        """Bin raw rows with the train mappers -> [F, N] feature-major
+        (bundle-encoded when the train set used EFB)."""
         ds = self.train_data
         f = max(ds.num_features, 1)
         dtype = np.uint8 if ds.max_bin_global <= 256 else np.int32
-        bins_t = np.zeros((f, X.shape[0]), dtype)
+        bins = np.zeros((X.shape[0], f), dtype)
         for i, real in enumerate(ds.used_feature_map):
-            bins_t[i] = ds.mappers[i].value_to_bin(X[:, real]).astype(dtype)
-        return bins_t
+            bins[:, i] = ds.mappers[i].value_to_bin(
+                X[:, real]).astype(dtype)
+        if ds.bundles is not None and getattr(self, "_use_bundles",
+                                              False):
+            from ..io.efb import bundle_bins
+            db = np.array([m.default_bin for m in ds.mappers], np.int32)
+            nb = np.array([m.num_bin for m in ds.mappers], np.int32)
+            bins, _, _, _ = bundle_bins(bins, ds.bundles, db, nb)
+        return np.ascontiguousarray(bins.T)
 
-    def predict(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        raw = self.predict_raw(X, num_iteration)
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                **pred_kw) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, **pred_kw)
         if self.objective is not None:
             # convert_output operates class-major [K, N] like the
             # reference's ConvertOutput; predict_raw returns [N, K]
@@ -748,6 +843,55 @@ class GBDT:
             return out[0]
         return out.transpose(1, 0, 2).reshape(n, k * f1)
 
+    def refit_existing(self, decay_rate: Optional[float] = None) -> None:
+        """RefitTree (gbdt.cpp:265-289) against the CURRENT train_data:
+        keep every tree's structure, re-learn its leaf outputs on the
+        new data's gradients, blending with refit_decay_rate
+        (FitByExistingTree, serial_tree_learner.cpp:223-253:
+        new = decay*old + (1-decay) * (-sum_g/(sum_h+l2)) * shrinkage).
+        Sequential like the reference: iteration i's gradients see the
+        refit outputs of iterations 0..i-1. Call after
+        ``init_from_loaded`` bound this booster to the new dataset."""
+        cfg = self.config
+        decay = cfg.refit_decay_rate if decay_rate is None else decay_rate
+        if self.objective is None:
+            log.fatal("Refit requires an objective")
+        K = self.num_tree_per_iteration
+        L = self._grower_cfg.num_leaves
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        mds = cfg.max_delta_step
+        from ..ops.split import KEPSILON, calculate_leaf_output
+
+        @jax.jit
+        def refit_one(scores_k, rec_leaf_output, leaf, g_k, h_k, shrink):
+            sg = jnp.zeros(L, jnp.float32).at[leaf].add(g_k)
+            sh = jnp.full(L, KEPSILON, jnp.float32).at[leaf].add(h_k)
+            new_out = calculate_leaf_output(sg, sh, l1, l2, mds) * shrink
+            out = decay * rec_leaf_output + (1.0 - decay) * new_out
+            return scores_k + out[leaf], out
+
+        self._init_scores()
+        n_iters = len(self.records) // K
+        for it in range(n_iters):
+            g_all, h_all = self.objective.get_gradients(
+                self._scores if K > 1 else self._scores[0])
+            if K == 1:
+                g_all, h_all = g_all[None, :], h_all[None, :]
+            for k in range(K):
+                t = it * K + k
+                rec = self.records[t]
+                leaf = replay_partition(rec, self._bins_dev,
+                                        self._meta)[:self._n]
+                new_scores, out = refit_one(
+                    self._scores[k], rec.leaf_output, leaf,
+                    g_all[k], h_all[k],
+                    jnp.float32(self._tree_shrinkage[t]))
+                self._scores = self._scores.at[k].set(new_scores)
+                self.records[t] = rec._replace(leaf_output=out)
+                self.models[t] = None
+        log.info("Refit %d trees with decay_rate=%g", len(self.records),
+                 decay)
+
     # -- CLI training driver (gbdt.cpp:245-263 GBDT::Train) ------------------
 
     def train(self, snapshot_freq: int = -1, output_model: str = "") -> None:
@@ -779,8 +923,11 @@ class GBDT:
                 break
         self.finish_training()
         if output_model:
-            self.save_model_to_file(output_model)
+            with timing.phase("io/save_model"):
+                self.save_model_to_file(output_model)
             log.info("Finished training; model saved to %s", output_model)
+        timing.log_report("training phase timings "
+                          "(serial_tree_learner.cpp:14-41 analog)")
 
     def _eval_and_check_early_stopping(self) -> bool:
         best_msg = self._output_metric(self.iter_)

@@ -72,10 +72,21 @@ def pack_record(rec: TreeRecord) -> jax.Array:
     """
     f32 = jnp.float32
     # cat words carry full 32-bit patterns: split into exact 16-bit
-    # halves (f32 holds ints < 2^24 exactly; a raw int32 would round)
+    # halves (f32 holds ints < 2^24 exactly; a raw int32 would round).
+    # Counts are split the same way: leaf_count can reach N, and above
+    # 2^24 rows a single f32 would round it.
     w = rec.split_cat_words.astype(jnp.uint32)
     w_lo = jnp.bitwise_and(w, jnp.uint32(0xFFFF)).astype(f32)
     w_hi = jnp.right_shift(w, jnp.uint32(16)).astype(f32)
+
+    def cnt_split(c):
+        # round before the int cast: counts are f32 sums of ones and
+        # can sit at 99.99999 (cast alone would truncate to 99)
+        ci = jnp.round(c).astype(jnp.uint32)
+        return (jnp.bitwise_and(ci, jnp.uint32(0xFFFF)).astype(f32),
+                jnp.right_shift(ci, jnp.uint32(16)).astype(f32))
+    lc_lo, lc_hi = cnt_split(rec.leaf_count)
+    ic_lo, ic_hi = cnt_split(rec.internal_count)
     return jnp.concatenate([
         rec.num_leaves[None].astype(f32) if rec.num_leaves.ndim == 0
         else rec.num_leaves.astype(f32),
@@ -85,11 +96,11 @@ def pack_record(rec: TreeRecord) -> jax.Array:
         rec.split_gain.astype(f32),
         rec.split_default_left.astype(f32),
         rec.leaf_output.astype(f32),
-        rec.leaf_count.astype(f32),
+        lc_lo, lc_hi,
         rec.leaf_sum_g.astype(f32),
         rec.leaf_sum_h.astype(f32),
         rec.internal_value.astype(f32),
-        rec.internal_count.astype(f32),
+        ic_lo, ic_hi,
         rec.split_is_cat.astype(f32),
         w_lo.reshape(-1),
         w_hi.reshape(-1),
@@ -100,18 +111,29 @@ def unpack_record(arr, num_leaves_cap: int) -> dict:
     """Inverse of pack_record on a host numpy [P] row -> dict of arrays."""
     L = num_leaves_cap
     s = L - 1
+    import numpy as _np
+
+    def cnt_join(lo, hi):
+        return (_np.asarray(lo).astype(_np.int64)
+                + (_np.asarray(hi).astype(_np.int64) << 16)).astype(
+                    _np.float64)
     parts = {}
     off = 0
     parts["num_leaves"] = int(round(float(arr[0]))); off = 1
     for name in ("split_leaf", "split_feature", "split_bin", "split_gain",
                  "split_default_left"):
         parts[name] = arr[off:off + s]; off += s
-    for name in ("leaf_output", "leaf_count", "leaf_sum_g", "leaf_sum_h"):
+    parts["leaf_output"] = arr[off:off + L]; off += L
+    lc_lo = arr[off:off + L]; off += L
+    lc_hi = arr[off:off + L]; off += L
+    parts["leaf_count"] = cnt_join(lc_lo, lc_hi)
+    for name in ("leaf_sum_g", "leaf_sum_h"):
         parts[name] = arr[off:off + L]; off += L
-    for name in ("internal_value", "internal_count"):
-        parts[name] = arr[off:off + s]; off += s
+    parts["internal_value"] = arr[off:off + s]; off += s
+    ic_lo = arr[off:off + s]; off += s
+    ic_hi = arr[off:off + s]; off += s
+    parts["internal_count"] = cnt_join(ic_lo, ic_hi)
     parts["split_is_cat"] = arr[off:off + s] > 0.5; off += s
-    import numpy as _np
     w_lo = _np.asarray(arr[off:off + s * 8]).reshape(s, 8); off += s * 8
     w_hi = _np.asarray(arr[off:off + s * 8]).reshape(s, 8); off += s * 8
     parts["split_cat_words"] = (

@@ -19,6 +19,20 @@ import jax.numpy as jnp
 from .split import MISSING_NAN, MISSING_ZERO, NCAT_WORDS
 
 
+def member_column(bins_t, feat, meta):
+    """Fetch feature ``feat``'s bin column, decoding EFB bundles
+    (io/efb.py): in the member's range -> col - offset, outside (another
+    member active / all-default) -> the member's default bin. Compiles
+    to a plain row fetch when the dataset is unbundled."""
+    if jnp.ndim(meta.bundle) == 0:
+        return bins_t[feat].astype(jnp.int32)
+    col = bins_t[meta.bundle[feat]].astype(jnp.int32)
+    off = meta.offset[feat]
+    nb = meta.num_bin[feat]
+    return jnp.where((col >= off) & (col < off + nb), col - off,
+                     meta.default_bin[feat])
+
+
 def cat_bit_left(bin_col, cat_words):
     """True where the bin's bit is set in the left-set bitset.
 

@@ -14,7 +14,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .partition import apply_split
+from .partition import apply_split, member_column
 from .split import FeatureMeta
 
 
@@ -34,7 +34,7 @@ def replay_partition(rec, bins_t, meta: FeatureMeta):
         feat = rec.split_feature[i]
         enabled = rec.split_leaf[i] >= 0
         safe_feat = jnp.maximum(feat, 0)
-        bin_col = bins_t[safe_feat].astype(jnp.int32)
+        bin_col = member_column(bins_t, safe_feat, meta)
         return apply_split(
             leaf_ids, bin_col, rec.split_leaf[i], i + 1, rec.split_bin[i],
             rec.split_default_left[i], meta.missing_type[safe_feat],

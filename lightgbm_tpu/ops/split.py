@@ -70,6 +70,11 @@ class FeatureMeta(NamedTuple):
     # 1 = categorical (bin.h BinType); scalar-0 default broadcasts so
     # numerical-only constructors don't need the field
     is_cat: jax.Array = np.zeros((), np.int32)
+    # EFB (io/efb.py): member feature -> bundle column + bin offset.
+    # Scalar sentinel = identity (no bundling); shapes are trace-static
+    # so the decode compiles away entirely when unbundled.
+    bundle: jax.Array = np.zeros((), np.int32)
+    offset: jax.Array = np.zeros((), np.int32)
 
     @classmethod
     def from_mappers(cls, mappers, monotone_constraints=None,

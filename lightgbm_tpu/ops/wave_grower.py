@@ -39,7 +39,7 @@ import numpy as np
 
 from .grower import TreeRecord
 from .hist_wave import (fused_partition_histogram_pallas, wave_histogram)
-from .partition import row_goes_right
+from .partition import member_column, row_goes_right
 from .split import (FeatureMeta, SplitParams, SplitResult, KMIN_SCORE,
                     calculate_leaf_output, find_best_split)
 
@@ -148,8 +148,9 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         from .hist_wave import FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO
         fused_cap = (FUSED_MAX_WAVE_HILO if cfg.precision != "default"
                      else FUSED_MAX_WAVE)
+        bundled = jnp.ndim(meta.bundle) != 0
         use_fused = (default_seams and W <= fused_cap
-                     and _pallas_on(cfg.use_pallas))
+                     and not bundled and _pallas_on(cfg.use_pallas))
     if use_fused:
         from ..utils.device import on_tpu
         fused_interpret = not on_tpu()
@@ -444,9 +445,9 @@ def apply_wave_splits(bins_t, leaf_ids, wl, new_ids, feat, tbin, dleft,
     W = wl.shape[0]
     out = leaf_ids
     for k in range(W):
-        col = bins_t[feat[k]]                    # [N] dynamic row slice
+        col = member_column(bins_t, feat[k], meta)   # EFB-decoded
         right = row_goes_right(
-            col.astype(jnp.int32), tbin[k], dleft[k],
+            col, tbin[k], dleft[k],
             meta.missing_type[feat[k]], meta.default_bin[feat[k]],
             meta.num_bin[feat[k]],
             is_cat=(False if iscat is None else iscat[k]),

@@ -73,8 +73,10 @@ def sync_best_splits(res: SplitResult) -> SplitResult:
 
 
 def _slice_meta(meta: FeatureMeta, start, size: int) -> FeatureMeta:
+    # scalar-sentinel fields (is_cat/bundle/offset defaults) pass through
     return FeatureMeta(*[
-        jax.lax.dynamic_slice_in_dim(jnp.asarray(a), start, size, 0)
+        a if jnp.ndim(a) == 0
+        else jax.lax.dynamic_slice_in_dim(jnp.asarray(a), start, size, 0)
         for a in meta])
 
 
@@ -198,12 +200,17 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         elected_hist = jax.lax.psum(
             jnp.take_along_axis(
                 hists, elected[:, :, None, None], axis=1), AXIS)
-        meta_e = FeatureMeta(*[a[elected] for a in meta_dev])  # [M, 2k]
+        meta_e = FeatureMeta(*[
+            a if jnp.ndim(a) == 0 else a[elected]
+            for a in meta_dev])                               # [M, 2k]
+        # scalar-sentinel fields broadcast, per-slot fields map
+        meta_axes = FeatureMeta(*[
+            None if jnp.ndim(a) == 0 else 0 for a in meta_e])
         fmask_e = fmask[elected]
         res = jax.vmap(
             lambda hh, a, b, c, fm, me, d: find_best_split(
                 hh, a, b, c, fm, me, cfg.hp, d),
-            in_axes=(0, 0, 0, 0, 0, 0, 0),
+            in_axes=(0, 0, 0, 0, 0, meta_axes, 0),
         )(elected_hist, sg, sh, nd, fmask_e, meta_e, can)
         return res._replace(
             feature=jnp.where(
@@ -225,12 +232,14 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
 
 def make_grower_for_mode(mode: str, cfg: WaveGrowerConfig,
                          meta: FeatureMeta, mesh: Optional[Mesh],
-                         num_features: int, top_k: int = 20):
+                         num_features: int, top_k: int = 20,
+                         hist_fn=None):
     """Factory matching TreeLearner::CreateTreeLearner
     (src/treelearner/tree_learner.cpp:9-33) — {serial, feature, data,
-    voting} on the tpu device type."""
+    voting} on the tpu device type. ``hist_fn`` overrides the serial
+    histogram seam (EFB bundle expansion, models/gbdt.py)."""
     if mode == "serial" or mesh is None or mesh.devices.size == 1:
-        return make_wave_grower(cfg, meta)
+        return make_wave_grower(cfg, meta, hist_fn=hist_fn)
     if mode == "data":
         return make_data_parallel_grower(cfg, meta, mesh)
     if mode == "feature":

@@ -1,0 +1,63 @@
+"""Per-phase wall-clock accounting.
+
+TPU-native counterpart of the reference's TIMETAG instrumentation
+(reference: src/treelearner/serial_tree_learner.cpp:14-41 init/hist/
+split timers, src/boosting/gbdt.cpp:253-256 per-iteration elapsed).
+A process-global accumulator keyed by phase name; training drivers log
+the table when a run finishes. On-device time is attributed to the
+phase that issued the work (jax dispatch is async — phases that need
+exact device time call ``block=True``).
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+
+from . import log
+
+_acc: "OrderedDict[str, float]" = OrderedDict()
+_counts: "OrderedDict[str, int]" = OrderedDict()
+
+
+@contextmanager
+def phase(name: str, block_on=None):
+    """Accumulate the wall time of a phase; ``block_on`` (a jax array /
+    pytree) is block_until_ready'd before the clock stops so device
+    work lands in the right bucket."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        if block_on is not None:
+            import jax
+            jax.block_until_ready(block_on)
+        _acc[name] = _acc.get(name, 0.0) + (time.monotonic() - t0)
+        _counts[name] = _counts.get(name, 0) + 1
+
+
+def add(name: str, seconds: float) -> None:
+    _acc[name] = _acc.get(name, 0.0) + seconds
+    _counts[name] = _counts.get(name, 0) + 1
+
+
+def reset() -> None:
+    _acc.clear()
+    _counts.clear()
+
+
+def report() -> str:
+    """One line per phase: total seconds, calls, mean ms."""
+    lines = []
+    for name, total in _acc.items():
+        n = max(_counts.get(name, 1), 1)
+        lines.append(f"  {name:<24s} {total:9.3f} s  ({n} calls, "
+                     f"{1000.0 * total / n:.2f} ms avg)")
+    return "\n".join(lines)
+
+
+def log_report(header: str = "phase timings") -> None:
+    """Log and RESET — each report covers one run's deltas."""
+    if _acc:
+        log.info("%s:\n%s", header, report())
+        reset()
