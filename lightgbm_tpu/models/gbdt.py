@@ -112,7 +112,7 @@ class GBDT:
             bins_t = np.pad(bins_t, ((0, 0), (0, self._pad_rows)))
         if self._pad_features:
             bins_t = np.pad(bins_t, ((0, self._pad_features), (0, 0)))
-        with timing.phase("init/upload_bins", block_on=None):
+        with timing.phase("init/upload_bins"):
             self._bins_dev = jnp.asarray(bins_t)
         self._full_mask_dev = jnp.asarray(np.concatenate(
             [np.ones(self._n, np.float32),
@@ -734,10 +734,10 @@ class GBDT:
             self._ensure_host_trees()
             out = np.zeros((k, n), np.float64)
             active = np.arange(n)
+            Xa = X                      # re-sliced only when rows stop
             for t_idx in range(first, ntree):
                 cls = t_idx % k
-                out[cls, active] += \
-                    self.models[t_idx].predict(X[active])
+                out[cls, active] += self.models[t_idx].predict(Xa)
                 done_group = ((t_idx - first + 1) % max(
                     pred_early_stop_freq * k, 1) == 0)
                 if done_group and len(active):
@@ -746,7 +746,10 @@ class GBDT:
                     else:
                         part = np.sort(out[:, active], axis=0)
                         margin = part[-1] - part[-2]
-                    active = active[margin <= pred_early_stop_margin]
+                    keep = margin <= pred_early_stop_margin
+                    if not keep.all():
+                        active = active[keep]
+                        Xa = X[active]
                     if not len(active):
                         break
             if self.average_output:

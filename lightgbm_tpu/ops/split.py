@@ -424,22 +424,24 @@ def find_best_split(hist: jax.Array, sum_g, sum_h, num_data,
         gc1, gc2, cctx = _categorical_tables(
             hist, ctx["sum_g"], ctx["sum_h2"], ctx["num_data"],
             feature_mask, meta, hp, can_split, min_gain_shift)
+        # flatten [F, 4, B]: numerical dir=-1 first with REVERSED
+        # thresholds (so larger t wins ties), numerical dir=+1
+        # ascending, then the categorical dir=+1 / dir=-1 candidate
+        # tables (a feature is either numerical or categorical, so the
+        # blocks never compete within one feature). argmax = first max.
+        cand = jnp.stack([g2[:, ::-1], g1, gc1, gc2], axis=1)
+        nbranch = 4
     else:
-        gc1 = gc2 = jnp.full((F, B), KMIN_SCORE)
+        # numerical-only: the 2-branch table of the original design
+        # (half the argmax scan; the cat machinery is compiled out)
+        cand = jnp.stack([g2[:, ::-1], g1], axis=1)
         cctx = None
-
-    # --- argmax with reference tie-break order --------------------------
-    # flatten [F, 4, B]: numerical dir=-1 first with REVERSED thresholds
-    # (so larger t wins ties), numerical dir=+1 ascending, then the
-    # categorical dir=+1 / dir=-1 candidate tables (a feature is either
-    # numerical or categorical, so the blocks never compete within one
-    # feature). argmax returns the first max.
-    cand = jnp.stack([g2[:, ::-1], g1, gc1, gc2], axis=1)  # [F, 4, B]
+        nbranch = 2
     flat = cand.reshape(-1)
     idx = jnp.argmax(flat)
     best_gain = flat[idx]
-    fi = idx // (4 * B)
-    rem = idx % (4 * B)
+    fi = idx // (nbranch * B)
+    rem = idx % (nbranch * B)
     d = rem // B                  # 0 num dir=-1, 1 num dir=+1, 2/3 cat
     tb = rem % B
     t = jnp.where(d == 0, B - 1 - tb, tb)            # undo reversal

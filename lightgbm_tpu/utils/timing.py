@@ -4,9 +4,10 @@ TPU-native counterpart of the reference's TIMETAG instrumentation
 (reference: src/treelearner/serial_tree_learner.cpp:14-41 init/hist/
 split timers, src/boosting/gbdt.cpp:253-256 per-iteration elapsed).
 A process-global accumulator keyed by phase name; training drivers log
-the table when a run finishes. On-device time is attributed to the
-phase that issued the work (jax dispatch is async — phases that need
-exact device time call ``block=True``).
+the table when a run finishes. jax dispatch is async, so a phase's
+bucket holds the HOST time it spent issuing work; queued device time
+lands in whichever later phase first synchronizes. Callers that need
+exact device attribution should block_until_ready inside the phase.
 """
 from __future__ import annotations
 
@@ -21,17 +22,12 @@ _counts: "OrderedDict[str, int]" = OrderedDict()
 
 
 @contextmanager
-def phase(name: str, block_on=None):
-    """Accumulate the wall time of a phase; ``block_on`` (a jax array /
-    pytree) is block_until_ready'd before the clock stops so device
-    work lands in the right bucket."""
+def phase(name: str):
+    """Accumulate the wall time spent inside the block."""
     t0 = time.monotonic()
     try:
         yield
     finally:
-        if block_on is not None:
-            import jax
-            jax.block_until_ready(block_on)
         _acc[name] = _acc.get(name, 0.0) + (time.monotonic() - t0)
         _counts[name] = _counts.get(name, 0) + 1
 

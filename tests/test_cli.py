@@ -213,6 +213,27 @@ output_model = {tmp_path}/model.txt
         finally:
             os.chdir(cwd)
 
+    @pytest.mark.parametrize("example", [
+        "binary_classification", "regression", "multiclass_classification",
+        "lambdarank", "parallel_learning"])
+    def test_reference_example_configs(self, tmp_path, example):
+        """All five reference example configs train end-to-end
+        (the north-star's 'via CLI' wording; application.cpp flow)."""
+        conf = f"/root/reference/examples/{example}/train.conf"
+        if not os.path.exists(conf):
+            pytest.skip("reference examples not mounted")
+        out = str(tmp_path / "model.txt")
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            Application([f"config={conf}", "num_trees=2", "verbose=-1",
+                         f"output_model={out}"]).run()
+        finally:
+            os.chdir(cwd)
+        text = open(out).read()
+        assert text.startswith("tree")
+        assert "Tree=" in text
+
     def test_parse_config_file(self, tmp_path):
         conf = str(tmp_path / "c.conf")
         with open(conf, "w") as fh:

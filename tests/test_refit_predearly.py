@@ -1,0 +1,115 @@
+"""Refit + prediction-early-stop regression tests.
+
+Reference: src/boosting/gbdt.cpp:265-289 RefitTree /
+serial_tree_learner.cpp:223-253 FitByExistingTree;
+src/boosting/prediction_early_stop.cpp:20-84.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=500, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+class TestRefit:
+    def test_refit_improves_on_shifted_data(self):
+        X, y = _data()
+        bst = lgb.train({"objective": "binary", "verbose": -1,
+                         "min_data_in_leaf": 5}, lgb.Dataset(X, y), 10,
+                        verbose_eval=False)
+        X2 = X + 0.15
+        y2 = (X2[:, 0] + 0.5 * X2[:, 1] > 0).astype(np.float64)
+
+        def ll(yy, p):
+            p = np.clip(p, 1e-12, 1 - 1e-12)
+            return float(-np.mean(yy * np.log(p)
+                                  + (1 - yy) * np.log(1 - p)))
+        r = bst.refit(X2, y2, decay_rate=0.5)
+        assert r.num_trees() == bst.num_trees()
+        assert ll(y2, r.predict(X2)) < ll(y2, bst.predict(X2))
+
+    def test_decay_one_is_identity(self):
+        """decay_rate=1 keeps every leaf output
+        (FitByExistingTree blend, serial_tree_learner.cpp:243)."""
+        X, y = _data()
+        bst = lgb.train({"objective": "binary", "verbose": -1,
+                         "min_data_in_leaf": 5}, lgb.Dataset(X, y), 5,
+                        verbose_eval=False)
+        same = bst.refit(X, y, decay_rate=1.0)
+        np.testing.assert_allclose(same.predict(X, raw_score=True),
+                                   bst.predict(X, raw_score=True),
+                                   atol=2e-4)
+
+    def test_cli_refit_task(self, tmp_path):
+        import os
+        from lightgbm_tpu.application import Application
+        X, y = _data(300, 5)
+        data = str(tmp_path / "t.tsv")
+        with open(data, "w") as fh:
+            for i in range(len(y)):
+                fh.write("\t".join([f"{y[i]:g}"]
+                                   + [f"{v:.5f}" for v in X[i]]) + "\n")
+        model = str(tmp_path / "m.txt")
+        refit_out = str(tmp_path / "m2.txt")
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            Application([f"data={data}", "objective=binary",
+                         "num_trees=4", "verbose=-1",
+                         "min_data_in_leaf=5",
+                         f"output_model={model}"]).run()
+            Application(["task=refit", f"data={data}",
+                         "objective=binary", "verbose=-1",
+                         f"input_model={model}",
+                         f"output_model={refit_out}"]).run()
+        finally:
+            os.chdir(cwd)
+        assert "Tree=3" in open(refit_out).read()
+
+
+class TestPredEarlyStop:
+    def test_binary_sign_preserved(self):
+        X, y = _data()
+        bst = lgb.train({"objective": "binary", "verbose": -1,
+                         "min_data_in_leaf": 5}, lgb.Dataset(X, y), 40,
+                        verbose_eval=False)
+        exact = bst.predict(X, raw_score=True)
+        es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                         pred_early_stop_freq=5,
+                         pred_early_stop_margin=4.0)
+        assert ((exact > 0) == (es > 0)).all()
+        # some rows actually stopped early (values differ)
+        assert (exact != es).any()
+        # a huge margin means no early stop at all
+        no_stop = bst.predict(X, raw_score=True, pred_early_stop=True,
+                              pred_early_stop_freq=5,
+                              pred_early_stop_margin=1e9)
+        np.testing.assert_allclose(no_stop, exact, atol=1e-5)
+
+    def test_multiclass_argmax_preserved(self):
+        rng = np.random.default_rng(1)
+        y = rng.integers(0, 3, 400).astype(np.float64)
+        X = rng.normal(size=(400, 5))
+        X[:, 0] += 2 * y
+        bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "verbose": -1, "min_data_in_leaf": 5},
+                        lgb.Dataset(X, y), 25, verbose_eval=False)
+        exact = bst.predict(X, raw_score=True)
+        es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                         pred_early_stop_freq=3,
+                         pred_early_stop_margin=3.0)
+        assert (exact.argmax(1) == es.argmax(1)).mean() > 0.99
+
+    def test_regression_rejects_early_stop(self):
+        X, y = _data()
+        bst = lgb.train({"objective": "regression", "verbose": -1},
+                        lgb.Dataset(X, y), 10, verbose_eval=False)
+        exact = bst.predict(X, raw_score=True)
+        ignored = bst.predict(X, raw_score=True, pred_early_stop=True)
+        np.testing.assert_allclose(ignored, exact, atol=1e-5)
