@@ -338,7 +338,6 @@ class Config:
     _UNIMPLEMENTED = {
         "two_round": False,
         "pre_partition": False,
-        "forcedsplits_filename": "",
         "convert_model_language": "",
         "machine_list_filename": "",
         "machines": "",

@@ -231,7 +231,8 @@ class GBDT:
             max_depth=cfg.max_depth,
             chunk=cfg.tpu_hist_chunk if cfg.tpu_hist_chunk > 0 else 0,
             hp=hp,
-            precision=precision)
+            precision=precision,
+            forced=self._parse_forced_splits())
         self._grower_cfg = gcfg
         hist_fn = None
         if self._use_bundles:
@@ -258,6 +259,57 @@ class GBDT:
             mode, gcfg, meta, mesh, self._f_pad, cfg.top_k,
             hist_fn=hist_fn)
         self._step_key = None       # grower changed: rebuild fused step
+
+    def _parse_forced_splits(self) -> tuple:
+        """forcedsplits_filename JSON -> BFS-ordered
+        ((parent_leaf, inner_feature, bin), ...) matching the
+        reference's ForceSplits leaf numbering
+        (serial_tree_learner.cpp:546-701: left child keeps the parent
+        leaf, right child takes the next id in application order)."""
+        cfg = self.config
+        if not cfg.forcedsplits_filename:
+            return ()
+        import collections
+        import json as _json
+        try:
+            with open(cfg.forcedsplits_filename) as fh:
+                spec = _json.load(fh)
+        except (OSError, ValueError) as e:
+            log.fatal(f"Cannot read forced splits file "
+                      f"{cfg.forcedsplits_filename!r}: {e}")
+        td = self.train_data
+        out = []
+        q = collections.deque([(spec, 0)])
+        next_leaf = 1
+        cap = max(cfg.num_leaves, 2) - 1
+        while q and len(out) < cap:
+            node, leaf = q.popleft()
+            if not isinstance(node, dict) or "feature" not in node:
+                continue
+            if "threshold" not in node:
+                log.fatal(f"Forced split node missing 'threshold': "
+                          f"{node!r}")
+            inner = td.real_to_inner.get(int(node["feature"]))
+            if inner is None:
+                log.warning("Forced split on unused feature %s skipped",
+                            node["feature"])
+                continue
+            if td.mappers[inner].bin_type == 1:   # BinType.CATEGORICAL
+                log.warning("Forced split on categorical feature %s is "
+                            "not supported; skipped", node["feature"])
+                continue
+            tbin = int(td.mappers[inner].value_to_bin(
+                np.asarray([float(node["threshold"])]))[0])
+            out.append((leaf, int(inner), tbin))
+            right_leaf = next_leaf
+            next_leaf += 1
+            if node.get("left"):
+                q.append((node["left"], leaf))
+            if node.get("right"):
+                q.append((node["right"], right_leaf))
+        if out:
+            log.info("Applying %d forced splits per tree", len(out))
+        return tuple(out)
 
     def _init_scores(self):
         n, k = self._n, self.num_tree_per_iteration

@@ -156,22 +156,31 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
         w_rows = jnp.pad(w_rows, ((0, 128 - nrow), (0, 0)))
 
     ct = gvec.shape[1]
-    gb = group_sz * B
-    # column vectors broadcastable against [gb, Ct]
-    row_iota = jax.lax.broadcasted_iota(jnp.int32, (gb, 1), 0)
-    which_feat = row_iota // B                          # [gb, 1]
-    which_bin = row_iota % B                            # [gb, 1]
+    Bp = _round_up(B, 8)       # 8-aligned per-feature stride: the
+    gb = group_sz * Bp         # concat below must not shuffle sublanes
+    bin_iota = jax.lax.broadcasted_iota(jnp.int32, (Bp, 1), 0)
+    # bf16 operands halve the one-hot tiles' footprint; numerically
+    # identical to the DEFAULT bf16 MXU pass (interpret mode keeps f32
+    # for the HIGHEST-precision CPU oracle)
+    oh_dt = jnp.float32 if exact_dot else jnp.bfloat16
+    w_mm = w_rows if exact_dot else w_rows.astype(jnp.bfloat16)
 
     for p in range(groups):
-        # stacked transposed one-hots of this group's features: row j is
-        # (bins_t[p*group_sz + j//B, :] == j % B)
-        sel = jnp.full((gb, ct), -1, jnp.int32)
+        # per-feature one-hot blocks concatenated on ALIGNED sublane
+        # boundaries: one compare per feature (the previous
+        # which_feat/select merge was VPU-bound — 2 selects + compare
+        # per element vs 1 compare here)
+        blocks = []
         for sidx in range(group_sz):
             f = p * group_sz + sidx
             if f < F:
                 row = bins_ref[f, :].astype(jnp.int32)  # [Ct] lanes
-                sel = jnp.where(which_feat == sidx, row[None, :], sel)
-        oh_t = (sel == which_bin).astype(jnp.float32)   # [gb, Ct]
+                blocks.append(
+                    (row[None, :] == bin_iota).astype(oh_dt))
+            else:
+                blocks.append(jnp.zeros((Bp, ct), oh_dt))
+        oh_t = (blocks[0] if group_sz == 1
+                else jnp.concatenate(blocks, axis=0))   # [gb, Ct]
         # contract the LANE axis of both operands: [gb, Ct] x [128, Ct]
         # -> [gb, 128]. DEFAULT precision = one bf16 MXU pass; one-hot
         # entries and the hi/lo rows are exactly bf16-representable, so
@@ -179,7 +188,7 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
         # interpret mode (CPU tests) the XLA CPU "default" dot has
         # different split-precision numerics, so force HIGHEST there.
         acc = jax.lax.dot_general(
-            oh_t, w_rows, dimension_numbers=(((1,), (1,)), ((), ())),
+            oh_t, w_mm, dimension_numbers=(((1,), (1,)), ((), ())),
             precision=(jax.lax.Precision.HIGHEST if exact_dot
                        else jax.lax.Precision.DEFAULT),
             preferred_element_type=jnp.float32)         # [gb, 128]
@@ -215,8 +224,9 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     if ncol > 128:
         raise NotImplementedError(
             f"wave_size {W} needs {5 if hilo else 3}W <= 128 lanes")
-    group_sz = max(1, 128 // B)        # features per matmul M-tile
-    gb = group_sz * B
+    Bp = _round_up(B, 8)               # aligned per-feature row stride
+    group_sz = max(1, 128 // Bp)       # features per matmul M-tile
+    gb = group_sz * Bp
     groups = -(-F // group_sz)
     gb_pad = _round_up(gb, 128)
 
@@ -263,7 +273,9 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     )(wl, bins_t, ghl)
 
     # [groups, gb_pad, 128] -> [F, B, ncol] -> [W, F, B, 3]
-    out = out[:, :gb, :ncol].reshape(groups * group_sz, B, ncol)[:F]
+    # (feature rows sit at the aligned Bp stride; slice back to B)
+    out = out[:, :gb, :ncol].reshape(
+        groups * group_sz, Bp, ncol)[:F, :B]
     if hilo:
         out = out.reshape(F, B, 5, W)
         out = jnp.stack([out[:, :, 0] + out[:, :, 1],     # g = hi + lo
@@ -409,20 +421,28 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
         w_rows = jnp.pad(w_rows, ((0, 128 - nrow), (0, 0)))
 
     # ---- one-hot tiles + lane-contracting MXU accumulate ----
-    gb = group_sz * B
-    row_iota = jax.lax.broadcasted_iota(i32, (gb, 1), 0)
-    which_feat = row_iota // B
-    which_bin = row_iota % B
+    Bp = _round_up(B, 8)       # aligned per-feature stride (see
+    gb = group_sz * Bp         # _wave_hist_kernel)
+    bin_iota = jax.lax.broadcasted_iota(i32, (Bp, 1), 0)
+    # bf16 operands halve the one-hot tile's VMEM/register footprint;
+    # numerically identical to the DEFAULT bf16 MXU pass (interpret
+    # mode keeps f32 for the HIGHEST-precision CPU oracle)
+    oh_dt = jnp.float32 if exact_dot else jnp.bfloat16
+    w_mm = w_rows if exact_dot else w_rows.astype(jnp.bfloat16)
     for p in range(groups):
-        sel = jnp.full((gb, ct), -1, i32)
+        blocks = []
         for sidx in range(group_sz):
             f = p * group_sz + sidx
             if f < F:
                 row = binsf_ref[f, :].astype(i32)
-                sel = jnp.where(which_feat == sidx, row[None, :], sel)
-        oh_t = (sel == which_bin).astype(jnp.float32)
+                blocks.append(
+                    (row[None, :] == bin_iota).astype(oh_dt))
+            else:
+                blocks.append(jnp.zeros((Bp, ct), oh_dt))
+        oh_t = (blocks[0] if group_sz == 1
+                else jnp.concatenate(blocks, axis=0))
         acc = jax.lax.dot_general(
-            oh_t, w_rows, dimension_numbers=(((1,), (1,)), ((), ())),
+            oh_t, w_mm, dimension_numbers=(((1,), (1,)), ((), ())),
             precision=(jax.lax.Precision.HIGHEST if exact_dot
                        else jax.lax.Precision.DEFAULT),
             preferred_element_type=jnp.float32)
@@ -454,8 +474,9 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     if W > cap:
         raise NotImplementedError(f"fused wave needs W <= {cap}")
     nchan = 5 if hilo else 4
-    group_sz = max(1, 128 // B)
-    gb = group_sz * B
+    Bp = _round_up(B, 8)
+    group_sz = max(1, 128 // Bp)
+    gb = group_sz * Bp
     groups = -(-F // group_sz)
     gb_pad = _round_up(gb, 128)
 
@@ -512,8 +533,9 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
 
     # [groups, gb_pad, 128] -> [F, B, nchan*W] -> [W, F, B, 3].
     # channel rows were [c*W + k]: reshape (nchan, W) then combine
+    # (feature rows sit at the aligned Bp stride; slice back to B)
     hist = hist[:, :gb, :nchan * W].reshape(
-        groups * group_sz, B, nchan * W)[:F]
+        groups * group_sz, Bp, nchan * W)[:F, :B]
     hist = hist.reshape(F, B, nchan, W)
     if hilo:
         hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo

@@ -62,6 +62,10 @@ class WaveGrowerConfig(NamedTuple):
     # whenever the Pallas path is on and W fits; interpret mode is used
     # off-TPU so tests exercise the same code path.
     fused: bool | None = None
+    # forced splits (forcedsplits_filename, serial_tree_learner.cpp:546
+    # ForceSplits): BFS-ordered ((parent_leaf, inner_feature, bin), ...)
+    # applied as a fixed prefix before gain-driven growth
+    forced: tuple = ()
 
 
 class _State(NamedTuple):
@@ -419,6 +423,133 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 rec=rec,
             )
             return state
+
+        # ---- forced-split prefix (ForceSplits) ----
+        # Each forced split is applied like a single-slot wave with the
+        # (feature, bin) CHOSEN instead of elected; children then get
+        # their gain tables so gain-driven growth continues from leaf
+        # numbering identical to the reference's BFS application.
+        # (This intentionally mirrors body() steps 3-7 with the
+        # election replaced — keep the two in sync.)
+        for fs_leaf, fs_feat, fs_bin in cfg.forced:
+            wl = jnp.concatenate([jnp.full(1, fs_leaf, jnp.int32),
+                                  jnp.full(W - 1, -1, jnp.int32)])
+            new_ids = jnp.concatenate(
+                [state.num_leaves[None].astype(jnp.int32),
+                 jnp.full(W - 1, -1, jnp.int32)])
+            feat = jnp.full(W, fs_feat, jnp.int32)
+            tbin = jnp.full(W, fs_bin, jnp.int32)
+            dleft = jnp.zeros(W, bool)
+            active = wl >= 0
+            iscat0 = jnp.zeros(W, bool)
+            catw0 = jnp.zeros((W, 8), jnp.int32)
+            leaf_ids = partition_fn(bins_t, state.leaf_ids, wl, new_ids,
+                                    feat, tbin, dleft, active,
+                                    iscat0, catw0)
+            # left child keeps the parent id: histogram it directly,
+            # sibling by subtraction (sizes don't matter here)
+            hist_left = hist_fn(bins_t, grad, hess,
+                                bag_mask_ids(leaf_ids), wl)
+            parent_hist = state.hist[wl]
+            hist_right = parent_hist - hist_left
+            wl_s = jnp.where(active, wl, L)
+            new_s = jnp.where(active, new_ids, L)
+            pool = state.hist.at[wl_s].set(hist_left, mode="drop")
+            pool = pool.at[new_s].set(hist_right, mode="drop")
+            # child sums from any one feature's bins (every row lands
+            # in exactly one bin per feature)
+            lg = hist_left[:, 0, :, 0].sum(axis=1)
+            lh = hist_left[:, 0, :, 1].sum(axis=1)
+            lcnt = hist_left[:, 0, :, 2].sum(axis=1)
+            rg = state.leaf_sum_g[wl] - lg
+            rh = state.leaf_sum_h[wl] - lh
+            rcnt = state.leaf_count[wl] - lcnt
+            parent_out = calculate_leaf_output(
+                state.leaf_sum_g[wl], state.leaf_sum_h[wl],
+                hp.lambda_l1, hp.lambda_l2, hp.max_delta_step)
+            # real gain like the reference's GatherInfoForThreshold:
+            # children's split gains minus the parent's
+            from .split import leaf_split_gain
+            forced_gain = (
+                leaf_split_gain(lg, lh + 1e-15, hp.lambda_l1,
+                                hp.lambda_l2, hp.max_delta_step)
+                + leaf_split_gain(rg, rh + 1e-15, hp.lambda_l1,
+                                  hp.lambda_l2, hp.max_delta_step)
+                - leaf_split_gain(state.leaf_sum_g[wl],
+                                  state.leaf_sum_h[wl] + 2e-15,
+                                  hp.lambda_l1, hp.lambda_l2,
+                                  hp.max_delta_step))
+            pos = jnp.where(active, state.n_splits, L - 1)
+            rec = state.rec
+            rec = rec._replace(
+                num_leaves=rec.num_leaves + 1,
+                split_leaf=rec.split_leaf.at[pos].set(wl, mode="drop"),
+                split_feature=rec.split_feature.at[pos].set(
+                    feat, mode="drop"),
+                split_bin=rec.split_bin.at[pos].set(tbin, mode="drop"),
+                split_gain=rec.split_gain.at[pos].set(
+                    forced_gain, mode="drop"),
+                split_default_left=rec.split_default_left.at[pos].set(
+                    dleft, mode="drop"),
+                internal_value=rec.internal_value.at[pos].set(
+                    parent_out, mode="drop"),
+                internal_count=rec.internal_count.at[pos].set(
+                    state.leaf_count[wl], mode="drop"),
+            )
+            child_depth = state.leaf_depth[wl] + 1
+
+            def updf(arr, lv, rv):
+                arr = arr.at[wl_s].set(lv, mode="drop")
+                return arr.at[new_s].set(rv, mode="drop")
+            # empty-child guard: the reference refuses degenerate
+            # forced splits (ForceSplits count checks); here the empty
+            # side just gets a zero output instead of -0/0 = NaN
+            lo = jnp.where(lcnt > 0, calculate_leaf_output(
+                lg, lh + 1e-15, hp.lambda_l1, hp.lambda_l2,
+                hp.max_delta_step), 0.0)
+            ro = jnp.where(rcnt > 0, calculate_leaf_output(
+                rg, rh + 1e-15, hp.lambda_l1, hp.lambda_l2,
+                hp.max_delta_step), 0.0)
+            hists2 = jnp.concatenate([hist_left, hist_right], axis=0)
+            sg2 = jnp.concatenate([lg, rg])
+            sh2 = jnp.concatenate([lh, rh])
+            nd2 = jnp.concatenate([lcnt, rcnt])
+            can2 = jnp.concatenate([active & depth_ok(child_depth)] * 2)
+            res = split_fn(hists2, sg2, sh2, nd2, feature_mask, can2)
+            gain2 = jnp.where(jnp.isfinite(res.gain), res.gain,
+                              KMIN_SCORE)
+            idx2 = jnp.concatenate([wl_s, new_s])
+            act2 = jnp.concatenate([active] * 2)
+            st = lambda tbl, v: _store_batch(tbl, idx2, v, act2)
+            state = state._replace(
+                leaf_ids=leaf_ids,
+                hist=pool,
+                t_gain=st(state.t_gain, gain2),
+                t_feature=st(state.t_feature, res.feature),
+                t_bin=st(state.t_bin, res.threshold_bin),
+                t_default_left=st(state.t_default_left,
+                                  res.default_left),
+                t_left_output=st(state.t_left_output, res.left_output),
+                t_right_output=st(state.t_right_output,
+                                  res.right_output),
+                t_left_count=st(state.t_left_count, res.left_count),
+                t_right_count=st(state.t_right_count, res.right_count),
+                t_left_sum_g=st(state.t_left_sum_g, res.left_sum_g),
+                t_left_sum_h=st(state.t_left_sum_h, res.left_sum_h),
+                t_right_sum_g=st(state.t_right_sum_g, res.right_sum_g),
+                t_right_sum_h=st(state.t_right_sum_h, res.right_sum_h),
+                t_is_cat=st(state.t_is_cat, res.is_cat),
+                t_cat_words=st(state.t_cat_words, res.cat_words),
+                leaf_output=updf(state.leaf_output, lo, ro),
+                leaf_count=updf(state.leaf_count, lcnt, rcnt),
+                leaf_sum_g=updf(state.leaf_sum_g, lg, rg),
+                leaf_sum_h=updf(state.leaf_sum_h, lh, rh),
+                leaf_depth=updf(state.leaf_depth, child_depth,
+                                child_depth),
+                num_leaves=state.num_leaves + 1,
+                n_splits=state.n_splits + 1,
+                rec=rec,
+            )
 
         state = jax.lax.while_loop(lambda s: s.go_on, body, state)
         rec = state.rec._replace(

@@ -73,6 +73,38 @@ class TestRefit:
         assert "Tree=3" in open(refit_out).read()
 
 
+class TestForcedSplits:
+    def test_forced_prefix_then_gain_growth(self, tmp_path):
+        """forcedsplits_filename forces the first splits of every tree
+        (ForceSplits, serial_tree_learner.cpp:546-701)."""
+        import json
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(600, 6))
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+        spec = {"feature": 3, "threshold": 0.2,
+                "left": {"feature": 4, "threshold": -0.1},
+                "right": {"feature": 4, "threshold": -0.1}}
+        path = str(tmp_path / "forced.json")
+        with open(path, "w") as fh:
+            json.dump(spec, fh)
+        bst = lgb.train({"objective": "binary", "verbose": -1,
+                         "min_data_in_leaf": 5, "num_leaves": 15,
+                         "forcedsplits_filename": path},
+                        lgb.Dataset(X, y), 5, verbose_eval=False,
+                        keep_training_booster=True)
+        bst._gbdt._ensure_host_trees()
+        for t in bst._gbdt.models:
+            assert t.split_feature[0] == 3          # forced root
+            assert t.split_feature[1] == 4          # forced child
+            assert t.split_feature[2] == 4          # forced child
+        # gain-driven growth continues and still learns the signal
+        assert ((bst.predict(X) > 0.5) == y).mean() > 0.9
+        # round-trips through the model format
+        loaded = lgb.Booster(model_str=bst.model_to_string())
+        np.testing.assert_allclose(loaded.predict(X), bst.predict(X),
+                                   atol=1e-5)
+
+
 class TestPredEarlyStop:
     def test_binary_sign_preserved(self):
         X, y = _data()
