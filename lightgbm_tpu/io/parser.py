@@ -164,6 +164,27 @@ def infer_label_idx(lines: List[str], fmt: str, num_features: int,
     return label_idx
 
 
+def _first_data_lines(filename: str, k: int, header: bool,
+                      ignore_comments: bool) -> Tuple[List[str], str]:
+    """First k data lines + the raw header line (cheap peek)."""
+    head = ""
+    out: List[str] = []
+    header_pending = header
+    with open(filename, "r") as fh:
+        for ln in fh:
+            t = ln.strip()
+            if not t or (ignore_comments and t.startswith("#")):
+                continue
+            if header_pending:
+                head = ln.rstrip("\r\n")
+                header_pending = False
+                continue
+            out.append(ln.rstrip("\r\n"))
+            if len(out) >= k:
+                break
+    return out, head
+
+
 def parse_file(filename: str, header: bool = False, label_idx: int = 0,
                num_features_hint: int = 0,
                ignore_comments: bool = True) -> Tuple[ParsedText, List[str]]:
@@ -171,26 +192,50 @@ def parse_file(filename: str, header: bool = False, label_idx: int = 0,
 
     header_names is empty when ``header`` is False. Comment lines
     starting with '#' and blank lines are skipped (TextReader parity,
-    include/LightGBM/utils/text_reader.h).
+    include/LightGBM/utils/text_reader.h). The heavy tokenization runs
+    in the native C++ parser when available (io/native.py); format and
+    label detection peek only the first lines either way.
     """
-    with open(filename, "r") as fh:
-        raw = fh.read().splitlines()
-    lines = [ln for ln in raw if ln.strip()
-             and not (ignore_comments and ln.lstrip().startswith("#"))]
+    first, head = _first_data_lines(filename, 2, header,
+                                    ignore_comments)
+    fmt = detect_format(first)
+    label_idx = infer_label_idx(first, fmt, num_features_hint,
+                                label_idx)
     names: List[str] = []
-    if header and lines:
-        head = lines.pop(0)
-        fmt_h = detect_format([head] + lines[:1])
-        delim = {"csv": ",", "tsv": "\t"}.get(fmt_h, "\t")
+    if header and head:
+        delim = {"csv": ",", "tsv": "\t"}.get(fmt, "\t")
         names = [t.strip() for t in head.split(delim)]
-    fmt = detect_format(lines[:2])
-    label_idx = infer_label_idx(lines, fmt, num_features_hint, label_idx)
-    if fmt == "libsvm":
-        parsed = parse_libsvm(lines, label_idx, num_features_hint)
+
+    from .native import parse_file_native
+    native = (parse_file_native(filename, header, label_idx)
+              if ignore_comments else None)
+    _FMT_CODE = {"tsv": 0, "csv": 1, "libsvm": 2}
+    if native is not None and native[2] != _FMT_CODE[fmt]:
+        # the native single-line sniff disagrees with the two-line
+        # detection (e.g. a ':' inside a CSV field) — trust the python
+        # detector and parser
+        native = None
+    if native is not None:
+        values, labels, _ = native
+        if fmt == "libsvm" and num_features_hint > values.shape[1]:
+            values = np.pad(values, ((0, 0), (0, num_features_hint
+                                              - values.shape[1])))
+        parsed = ParsedText(values, labels)
     else:
-        delim = "\t" if fmt == "tsv" else ","
-        parsed = parse_delimited(lines, delim, label_idx)
-    if names and parsed.label is not None and len(names) > parsed.num_columns:
+        with open(filename, "r") as fh:
+            raw = fh.read().splitlines()
+        lines = [ln for ln in raw if ln.strip()
+                 and not (ignore_comments
+                          and ln.lstrip().startswith("#"))]
+        if header and lines:
+            lines.pop(0)
+        if fmt == "libsvm":
+            parsed = parse_libsvm(lines, label_idx, num_features_hint)
+        else:
+            delim = "\t" if fmt == "tsv" else ","
+            parsed = parse_delimited(lines, delim, label_idx)
+    if names and parsed.label is not None \
+            and len(names) > parsed.num_columns:
         # drop the label column's name so names align with features
         names.pop(max(label_idx, 0))
     return parsed, names
