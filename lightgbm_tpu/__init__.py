@@ -19,9 +19,14 @@ try:
 except ImportError:          # scikit-learn not installed
     _SKLEARN_EXPORTS = []
 
+# plotting imports matplotlib lazily inside each function, so the
+# module itself always imports
+from .plotting import plot_importance, plot_metric, plot_tree
+_PLOT_EXPORTS = ["plot_importance", "plot_metric", "plot_tree"]
+
 __version__ = "0.3.0"
 
 __all__ = ["Dataset", "Booster", "train", "cv", "CVBooster",
            "LightGBMError", "EarlyStopException", "print_evaluation",
            "record_evaluation", "reset_parameter",
-           "early_stopping"] + _SKLEARN_EXPORTS
+           "early_stopping"] + _SKLEARN_EXPORTS + _PLOT_EXPORTS

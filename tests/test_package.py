@@ -407,3 +407,25 @@ class TestSklearn:
         est = lgb.LGBMRegressor(n_estimators=5, num_leaves=7)
         est2 = clone(est)
         assert est2.get_params()["num_leaves"] == 7
+
+
+class TestPlotting:
+    """plotting.py ports (reference test_plotting.py)."""
+
+    def test_plot_importance_and_metric(self, tmp_path):
+        mpl = pytest.importorskip("matplotlib")
+        mpl.use("Agg")
+        X, y = _binary_data(n=200)
+        ev = {}
+        gbm = lgb.train({"objective": "binary",
+                         "metric": "binary_logloss", "verbose": -1},
+                        lgb.Dataset(X, y), 8,
+                        valid_sets=lgb.Dataset(X, y, reference=None),
+                        verbose_eval=False, evals_result=ev)
+        ax = lgb.plot_importance(gbm)
+        assert ax.get_title() == "Feature importance"
+        assert len(ax.patches) > 0
+        ax2 = lgb.plot_metric(ev)
+        assert ax2.get_title() == "Metric during training"
+        ax3 = lgb.plot_tree(gbm, tree_index=0)
+        assert ax3.get_title() == "Tree 0"
