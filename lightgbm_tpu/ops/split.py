@@ -183,7 +183,11 @@ def _candidate_tables(hist: jax.Array, sum_g, sum_h, num_data,
     contrib_mask = (valid_bin & ~zero_bin & ~nan_bin).astype(f32)  # [F, B]
     contrib = hist * contrib_mask[:, :, None]                      # [F, B, 3]
 
-    cum = jnp.cumsum(contrib, axis=1)               # [F, B, 3] prefix sums
+    # prefix sums as a lower-triangular matmul: one MXU pass instead
+    # of a lane-shift cumsum chain (prefix-sum = tril @ x)
+    tril = jnp.tril(jnp.ones((B, B), f32))
+    cum = jnp.einsum("bk,fkc->fbc", tril, contrib,
+                     precision=jax.lax.Precision.HIGHEST)  # [F, B, 3]
     tot = cum[:, -1, :]                             # [F, 3]
 
     # --- dir = +1 : left accumulates from bin 0 (default right) ---------
