@@ -58,6 +58,59 @@ class Metadata:
         return len(self.query_boundaries) - 1
 
 
+def find_column_mappers(X: np.ndarray, config: Config,
+                        categorical=(), total_rows: Optional[int] = None,
+                        columns: Optional[Sequence[int]] = None
+                        ) -> List[Optional[BinMapper]]:
+    """Sample rows and find a BinMapper per column (trivial ones
+    included) — the shared bin-construction loop of
+    DatasetLoader::ConstructBinMappersFromTextData
+    (src/io/dataset_loader.cpp:196-235, 388-433).
+
+    ``total_rows`` is the GLOBAL row count when ``X`` is one shard of a
+    distributed load: the per-shard sample budget and the
+    min_data_in_leaf filter scale by the shard/global ratio, and every
+    shard must use the SAME total or their bin boundaries diverge.
+    ``columns`` restricts the search to a subset (the distributed
+    owner-rule workload split, dataset_loader.cpp:434-466); unowned
+    entries come back as None."""
+    X = np.asarray(X)
+    n, nf = X.shape
+    cfg = config
+    total = n if total_rows is None else max(int(total_rows), 1)
+    budget = cfg.bin_construct_sample_cnt
+    if total > n > 0:
+        budget = max(budget * n // total, 1)    # this shard's share
+    sample_cnt = min(budget, n)
+    rng = np.random.default_rng(cfg.data_random_seed)
+    if sample_cnt < n:
+        idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+        sample = X[idx]
+    else:
+        sample = X
+    snum = sample.shape[0]
+    filter_cnt = 0
+    if cfg.min_data_in_leaf > 0 and total > 0:
+        # dataset_loader.cpp: filter scaled by sample/total ratio
+        filter_cnt = max(int(cfg.min_data_in_leaf * snum / total), 1)
+    cats = set(categorical)
+    wanted = set(range(nf)) if columns is None else set(columns)
+    mappers: List[Optional[BinMapper]] = []
+    for j in range(nf):
+        if j not in wanted:
+            mappers.append(None)
+            continue
+        col = sample[:, j].astype(np.float64)
+        # reference samples only non-zero values; zeros are implied
+        nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+        m = BinMapper()
+        bt = (BinType.CATEGORICAL if j in cats else BinType.NUMERICAL)
+        m.find_bin(nonzero, snum, cfg.max_bin, cfg.min_data_in_bin,
+                   filter_cnt, bt, cfg.use_missing, cfg.zero_as_missing)
+        mappers.append(m)
+    return mappers
+
+
 class TpuDataset:
     """Constructed, binned training matrix + metadata."""
 
@@ -85,12 +138,16 @@ class TpuDataset:
     def construct_from_matrix(self, X: np.ndarray, metadata: Metadata,
                               categorical: Sequence[int] = (),
                               reference: Optional["TpuDataset"] = None,
-                              feature_names: Optional[List[str]] = None):
+                              feature_names: Optional[List[str]] = None,
+                              mappers: Optional[List[BinMapper]] = None):
         """Build bin mappers (or reuse reference's) and bin the matrix.
 
         Mirrors DatasetLoader::ConstructFromSampleData
         (src/io/dataset_loader.cpp:499) + Dataset::CreateValid
-        (src/io/dataset.cpp:368).
+        (src/io/dataset.cpp:368). ``mappers`` (one per REAL column,
+        trivial ones included) injects externally-agreed bin boundaries —
+        the distributed loader's synced mappers
+        (dataset_loader.cpp:434-466 Allgather of serialized BinMappers).
         """
         X = np.asarray(X)
         if X.dtype not in (np.float32, np.float64):
@@ -113,55 +170,37 @@ class TpuDataset:
             self.max_bin_global = reference.max_bin_global
             self.feature_names = reference.feature_names
             self.num_total_features = reference.num_total_features
+        elif mappers is not None:
+            self._set_mappers(mappers)
         else:
             with timing.phase("binning/find_bins"):
                 self._construct_mappers(X, set(categorical))
         with timing.phase("binning/bin_matrix"):
             self._bin_matrix(X)
-        with timing.phase("binning/efb"):
-            self._apply_efb()
+        if mappers is None:
+            # distributed shards skip EFB: bundling is data-dependent
+            # (find_bundles over LOCAL bins) and would diverge across
+            # ranks; parallel learners run unbundled anyway
+            with timing.phase("binning/efb"):
+                self._apply_efb()
         return self
 
     def _construct_mappers(self, X: np.ndarray, categorical: set) -> None:
-        cfg = self.config
-        n, nf = X.shape
-        # sampling (DatasetLoader::LoadFromFile sampling path,
-        # dataset_loader.cpp:196-235): sample rows for bin construction
-        sample_cnt = min(cfg.bin_construct_sample_cnt, n)
-        rng = np.random.default_rng(cfg.data_random_seed)
-        if sample_cnt < n:
-            sample_idx = np.sort(rng.choice(n, sample_cnt, replace=False))
-            sample = X[sample_idx]
-        else:
-            sample = X
-        total = sample.shape[0]
+        self._set_mappers(find_column_mappers(X, self.config, categorical))
 
-        filter_cnt = 0
-        if cfg.min_data_in_leaf > 0 and n > 0:
-            # dataset_loader.cpp: filter scaled by sample/total ratio
-            filter_cnt = max(
-                int(cfg.min_data_in_leaf * total / n), 1)
-
-        used, mappers = [], []
-        for j in range(nf):
-            col = sample[:, j].astype(np.float64)
-            # reference samples only non-zero values; zeros are implied
-            nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
-            m = BinMapper()
-            bt = (BinType.CATEGORICAL if j in categorical
-                  else BinType.NUMERICAL)
-            m.find_bin(nonzero, total, cfg.max_bin, cfg.min_data_in_bin,
-                       filter_cnt, bt, cfg.use_missing, cfg.zero_as_missing)
-            if not m.is_trivial:
-                used.append(j)
-                mappers.append(m)
-        if not mappers:
+    def _set_mappers(self, all_mappers: List[BinMapper]) -> None:
+        """Install per-REAL-column mappers: trivial-feature exclusion +
+        index maps (shared by local bin finding and distributed-agreed
+        injection)."""
+        used = [j for j, m in enumerate(all_mappers) if not m.is_trivial]
+        if not used:
             log.warning("There are no meaningful features, as all feature "
                         "values are constant.")
-        self.mappers = mappers
+        self.mappers = [all_mappers[j] for j in used]
         self.used_feature_map = np.asarray(used, np.int32)
         self.real_to_inner = {r: i for i, r in enumerate(used)}
-        self.max_bin_global = max((m.num_bin for m in mappers), default=1)
+        self.max_bin_global = max(
+            (m.num_bin for m in self.mappers), default=1)
 
     def _bin_matrix(self, X: np.ndarray) -> None:
         n = X.shape[0]

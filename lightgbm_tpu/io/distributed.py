@@ -1,0 +1,272 @@
+"""Distributed data loading: per-host row shards with globally agreed
+bin boundaries.
+
+TPU-native counterpart of the reference's distributed loading
+(reference: src/io/dataset_loader.cpp:163-167 round-robin/pre-partition
+row assignment, :434-466 distributed bin finding — each machine finds
+bins from its LOCAL sample and the serialized BinMappers ride an
+Allgather; sample-seed sync src/application/application.cpp:112-114).
+
+The TPU redesign: the "network" is the JAX runtime. In a multi-host
+program every process loads only its own rows (``pre_partition`` — one
+file per host) or its round-robin slice of a shared file, finds bin
+mappers locally, and the mapper exchange is a
+``multihost_utils.process_allgather`` of the serialized mappers instead
+of a socket Allgather. Single-process meshes (one host, many chips) need
+none of this — rows are sharded onto devices by ``shard_map`` in
+parallel/learners.py and binning is already global — but the loader
+also EMULATES S hosts in one process (tests, and the driver's virtual
+CPU mesh) by computing every rank's mappers from the data in hand.
+
+``shard_bin_mappers`` is the pure agreement rule; ``find_column_mappers``
+(io/dataset.py) is the shared per-column bin search, so single-host and
+distributed binning can never drift apart.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from .binning import BinMapper
+from .dataset import Metadata, TpuDataset, find_column_mappers
+from .loader import DatasetLoader
+
+
+def local_bin_mappers(X: np.ndarray, config: Config,
+                      categorical: Sequence[int] = (),
+                      total_rows: Optional[int] = None,
+                      columns: Optional[Sequence[int]] = None
+                      ) -> List[BinMapper]:
+    """One rank's locally-found mappers (trivial ones included).
+    ``total_rows`` is the GLOBAL row count — every rank must pass the
+    same value or boundaries diverge (see find_column_mappers).
+    ``columns`` restricts to the rank's owned subset."""
+    return find_column_mappers(X, config, categorical, total_rows,
+                               columns)
+
+
+def shard_bin_mappers(per_shard_mappers: List[List[BinMapper]]
+                      ) -> List[BinMapper]:
+    """The agreement rule: feature ``j`` takes shard ``j % S``'s locally
+    found mapper (the reference splits bin-finding workload round-robin
+    over machines and Allgathers the result, dataset_loader.cpp:434-466)
+    — every shard applies the same rule to the same gathered list, so
+    all shards end with identical mappers."""
+    S = len(per_shard_mappers)
+    nf = len(per_shard_mappers[0])
+    for ms in per_shard_mappers:
+        if len(ms) != nf:
+            log.fatal("Shards disagree on column count during "
+                      "distributed bin finding")
+    return [per_shard_mappers[j % S][j] for j in range(nf)]
+
+
+def _allgather_rowcount(n_local: int) -> int:
+    """Sum of every process's local row count — the exact global total
+    every rank must agree on before bin finding."""
+    import jax
+    if jax.process_count() == 1:
+        return n_local
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    counts = multihost_utils.process_allgather(
+        jnp.asarray([n_local], jnp.int64))
+    return int(np.sum(counts))
+
+
+def _allgather_mappers(local: List[Optional[BinMapper]]
+                       ) -> List[List[Optional[BinMapper]]]:
+    """Exchange serialized mappers across JAX processes
+    (multihost_utils.process_allgather as the Allgather wire)."""
+    import jax
+    if jax.process_count() == 1:
+        return [local]
+    import pickle
+    from jax.experimental import multihost_utils
+    import jax.numpy as jnp
+    blob = pickle.dumps([None if m is None else m.to_dict()
+                         for m in local])
+    # pad to the max blob length so the gather is rectangular
+    arr = np.frombuffer(blob, np.uint8)
+    ln = multihost_utils.process_allgather(
+        jnp.asarray([arr.size], jnp.int32))
+    maxlen = int(np.max(ln))
+    padded = np.zeros(maxlen, np.uint8)
+    padded[:arr.size] = arr
+    gathered = multihost_utils.process_allgather(jnp.asarray(padded))
+    out = []
+    for i in range(gathered.shape[0]):
+        raw = bytes(np.asarray(gathered[i])[: int(ln[i, 0])])
+        out.append([None if d is None else BinMapper.from_dict(d)
+                    for d in pickle.loads(raw)])
+    return out
+
+
+def _rank_rows(n: int, rank: int, world: int,
+               query_boundaries: Optional[np.ndarray]) -> np.ndarray:
+    """Round-robin assignment (dataset_loader.cpp:163-167). With query
+    boundaries, whole QUERIES are assigned round-robin so no query is
+    split across hosts (the reference partitions by query when
+    boundaries exist, src/io/metadata.cpp CheckOrPartition)."""
+    if query_boundaries is None:
+        return np.arange(rank, n, world)
+    nq = len(query_boundaries) - 1
+    qs = np.arange(rank, nq, world)
+    return np.concatenate([
+        np.arange(query_boundaries[q], query_boundaries[q + 1])
+        for q in qs]) if len(qs) else np.zeros(0, np.int64)
+
+
+def _slice_metadata(meta: Metadata, sel: np.ndarray, n: int,
+                    rank: int, world: int) -> Metadata:
+    """Shard-slice every metadata field. init_score is the flattened
+    [K*N] multiclass layout (io/loader.py) — sliced per class. Query
+    sizes are re-derived from the whole queries kept by _rank_rows."""
+    isc = meta.init_score
+    if isc is not None:
+        k = max(1, len(isc) // max(n, 1))
+        isc = np.asarray(isc).reshape(k, n)[:, sel].reshape(-1)
+    group = None
+    if meta.query_boundaries is not None:
+        qb = meta.query_boundaries
+        qs = np.arange(rank, len(qb) - 1, world)
+        group = np.diff(qb)[qs]
+    return Metadata(
+        label=None if meta.label is None else meta.label[sel],
+        weight=None if meta.weights is None else meta.weights[sel],
+        group=group, init_score=isc)
+
+
+class DistributedLoader:
+    """Per-host dataset loading with agreed bins.
+
+    ``world``/``rank`` default to the JAX process topology; tests pass
+    them explicitly to emulate S hosts in one process."""
+
+    def __init__(self, config: Config, world: Optional[int] = None,
+                 rank: Optional[int] = None):
+        import jax
+        self.config = config
+        self.world = jax.process_count() if world is None else world
+        self.rank = jax.process_index() if rank is None else rank
+
+    def _owned(self, rank: int, nf: int) -> List[int]:
+        """Columns whose bins rank ``rank`` finds (owner rule j % S)."""
+        return list(range(rank, nf, self.world))
+
+    def _emulated(self) -> bool:
+        import jax
+        return jax.process_count() == 1 and self.world > 1
+
+    # -- the one slice → agree → construct path -------------------------
+
+    def _load_shard(self, X: np.ndarray, meta: Metadata,
+                    categorical: Sequence[int], pre_partitioned: bool,
+                    shard_matrices: Optional[List[np.ndarray]],
+                    names: Optional[List[str]] = None) -> TpuDataset:
+        """``X``/``meta`` are the full data (round-robin mode) or this
+        host's rows (pre-partitioned). ``shard_matrices`` = every rank's
+        rows for emulated (one-process) agreement; None = the real
+        multi-process allgather.
+
+        Each rank finds bins only for its OWNED columns (j % S == rank,
+        the reference's workload split, dataset_loader.cpp:434-466);
+        the exchange assembles the full agreed set."""
+        X = np.asarray(X)
+        nf = X.shape[1]
+        round_robin = not pre_partitioned and self.world > 1
+        if round_robin:
+            sel = _rank_rows(X.shape[0], self.rank, self.world,
+                             meta.query_boundaries)
+            Xl = X[sel]
+            ml = _slice_metadata(meta, sel, X.shape[0],
+                                 self.rank, self.world)
+            total = X.shape[0]
+            if shard_matrices is None and self._emulated():
+                # shared data, one process: every rank's slice is in
+                # hand — true per-rank mappers, exact agreement
+                shard_matrices = [
+                    X[_rank_rows(X.shape[0], r, self.world,
+                                 meta.query_boundaries)]
+                    for r in range(self.world)]
+        else:
+            Xl, ml = X, meta
+            total = (sum(s.shape[0] for s in shard_matrices)
+                     if shard_matrices is not None
+                     else _allgather_rowcount(Xl.shape[0]))
+            if (shard_matrices is None and self._emulated()):
+                total = Xl.shape[0] * self.world    # best local guess
+
+        if shard_matrices is not None:
+            per_shard = [
+                find_column_mappers(s, self.config, categorical, total,
+                                    columns=self._owned(r, nf))
+                for r, s in enumerate(shard_matrices)]
+        else:
+            local = find_column_mappers(
+                Xl, self.config, categorical, total,
+                columns=self._owned(self.rank, nf))
+            per_shard = _allgather_mappers(local)
+            if len(per_shard) == 1 and self.world > 1:
+                log.warning(
+                    "distributed load with one JAX process and no peer "
+                    "data in hand: using this rank's local bins; pass "
+                    "all_shards=/peer_files= for emulated agreement")
+                # local only covers owned columns — fill the rest
+                local = find_column_mappers(Xl, self.config,
+                                            categorical, total)
+                per_shard = [local] * self.world
+        agreed = shard_bin_mappers(per_shard)
+        ds = TpuDataset(self.config)
+        ds.construct_from_matrix(Xl, ml, categorical=categorical,
+                                 feature_names=names, mappers=agreed)
+        return ds
+
+    # -- public entry points --------------------------------------------
+
+    def load_rank_matrix(self, X: np.ndarray, metadata: Metadata,
+                         categorical: Sequence[int] = (),
+                         pre_partitioned: bool = False,
+                         all_shards: Optional[List[np.ndarray]] = None
+                         ) -> TpuDataset:
+        """Construct this rank's shard dataset from an in-memory matrix.
+
+        pre_partitioned=True: ``X``/``metadata`` are ALREADY this host's
+        rows (the reference's pre_partition=true file-per-machine mode).
+        Otherwise rows (whole queries for ranking data) are assigned
+        round-robin ``i % world == rank``
+        (dataset_loader.cpp:163-167 used_data_indices).
+
+        ``all_shards`` supplies every shard's rows so the mapper
+        exchange can be emulated without multiple processes.
+        """
+        return self._load_shard(X, metadata, categorical,
+                                pre_partitioned, all_shards)
+
+    def load_rank_file(self, filename: str,
+                       pre_partitioned: Optional[bool] = None,
+                       peer_files: Optional[List[str]] = None
+                       ) -> TpuDataset:
+        """Text-file variant. pre_partition=true (config) = ``filename``
+        holds only this host's rows; otherwise every host parses the
+        shared file and keeps its round-robin slice. ``peer_files``
+        (single-process emulation/tests) lists EVERY host's
+        pre-partitioned file so the mapper exchange can run without
+        multiple JAX processes."""
+        cfg = self.config
+        if pre_partitioned is None:
+            pre_partitioned = cfg.pre_partition
+        ldr = DatasetLoader(cfg)
+        X, meta, names, categorical = ldr._parse_with_metadata(filename)
+        shard_matrices = None
+        if peer_files is not None:
+            shard_matrices = [ldr._parse_with_metadata(pf)[0]
+                              for pf in peer_files]
+        ds = self._load_shard(X, meta, categorical, pre_partitioned,
+                              shard_matrices, names or None)
+        log.info("Distributed load rank %d/%d: %d local rows",
+                 self.rank, self.world, ds.num_data)
+        return ds

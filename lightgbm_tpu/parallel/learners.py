@@ -177,24 +177,37 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         return jax.lax.psum(x, AXIS)
 
     def split_fn(hists, sg, sh, nd, fmask, can):
-        # 1. local per-feature gains over the LOCAL histograms with
-        #    per-shard totals and gates (the reference votes with local
-        #    leaf sumups and num_machines-scaled thresholds,
-        #    voting_parallel_tree_learner.cpp:53-55,151-160)
+        # 1. local per-feature gains over the LOCAL histograms with the
+        #    TRUE local leaf sumups (the reference votes with local
+        #    smaller_leaf_splits_, voting_parallel_tree_learner.cpp:151-160)
+        #    — every row lands in exactly one bin of feature 0, so the
+        #    bin-sum of any one feature's local histogram IS the local
+        #    leaf aggregate; gates stay num_machines-scaled (:53-55)
+        sg_l = hists[:, 0, :, 0].sum(axis=-1)             # [M]
+        sh_l = hists[:, 0, :, 1].sum(axis=-1)
+        nd_l = hists[:, 0, :, 2].sum(axis=-1)
         local_gain = jax.vmap(
             lambda hh, a, b, c, d: best_gain_per_feature(
                 hh, a, b, c, fmask, meta_dev, hp_vote, d)
-        )(hists, sg / D, sh / D, nd / D, can)            # [M, F]
+        )(hists, sg_l, sh_l, nd_l, can)                   # [M, F]
         _, local_top = jax.lax.top_k(local_gain, k)       # [M, k]
         # 2. global vote: one-hot count of each device's top-k per child
         m = local_gain.shape[0]
         votes = jnp.zeros((m, num_features), jnp.float32)
         votes = votes.at[jnp.arange(m)[:, None], local_top].add(1.0)
         votes = jax.lax.psum(votes, AXIS)
-        # deterministic tie-break by summed local gain rank
+        # exact lexicographic (votes, summed-local-gain) election: rank
+        # the gain sums 0..F-1 per child, then score = votes*F + rank —
+        # deterministic, no saturating squash
+        # gated features contribute 0 (not -inf: one device's gate must
+        # not veto a feature other devices can still split)
         finite_gain = jnp.where(jnp.isfinite(local_gain), local_gain, 0.0)
         gain_sum = jax.lax.psum(finite_gain, AXIS)
-        score = votes + 1e-6 * jax.nn.sigmoid(gain_sum)
+        order = jnp.argsort(gain_sum, axis=1)             # low -> high
+        rank = jnp.zeros_like(order).at[
+            jnp.arange(m)[:, None], order].set(
+                jnp.arange(num_features, dtype=order.dtype)[None, :])
+        score = votes * num_features + rank.astype(jnp.float32)
         _, elected = jax.lax.top_k(score, k2)             # [M, 2k]
         # 3. aggregate ONLY the elected features' histograms
         elected_hist = jax.lax.psum(
