@@ -1,0 +1,224 @@
+# R interface to lightgbm_tpu, mirroring the reference R package's API
+# (reference: R-package/R/lgb.Dataset.R, lgb.train.R, lgb.cv.R,
+# lgb.Booster.R — there the glue is src/lightgbm_R.cpp over the C API;
+# here the glue is reticulate over the Python package, which fronts the
+# same TPU engine).
+
+.lgb_env <- new.env(parent = emptyenv())
+
+.lgb_py <- function() {
+  if (is.null(.lgb_env$mod)) {
+    .lgb_env$mod <- reticulate::import("lightgbm_tpu", delay_load = FALSE)
+  }
+  .lgb_env$mod
+}
+
+#' Construct a Dataset (reference lgb.Dataset, R-package/R/lgb.Dataset.R)
+#' @param data matrix / data.frame of features
+#' @param label optional label vector
+#' @param weight optional row weights
+#' @param group optional query sizes (ranking)
+#' @param colnames optional feature names
+#' @param categorical_feature indices (1-based, R convention) or names
+#' @param free_raw_data kept for API compatibility (ignored: the Python
+#'   Dataset manages its own buffers)
+#' @param ... extra dataset parameters (max_bin, ...)
+#' @export
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        colnames = NULL, categorical_feature = NULL,
+                        free_raw_data = TRUE, reference = NULL, ...) {
+  lgb <- .lgb_py()
+  params <- list(...)
+  cat_py <- NULL
+  if (!is.null(categorical_feature)) {
+    cat_py <- if (is.numeric(categorical_feature)) {
+      as.integer(categorical_feature - 1L)   # R 1-based -> 0-based
+    } else {
+      as.list(categorical_feature)
+    }
+  }
+  ds <- lgb$Dataset(
+    data = reticulate::r_to_py(as.matrix(data)),
+    label = if (is.null(label)) NULL else as.numeric(label),
+    weight = if (is.null(weight)) NULL else as.numeric(weight),
+    group = if (is.null(group)) NULL else as.integer(group),
+    feature_name = if (is.null(colnames)) "auto" else as.list(colnames),
+    categorical_feature = if (is.null(cat_py)) "auto" else cat_py,
+    params = params,
+    reference = reference
+  )
+  class(ds) <- c("lgb.Dataset", class(ds))
+  ds
+}
+
+#' Validation Dataset bound to a training Dataset's bin mappers
+#' (reference lgb.Dataset.create.valid)
+#' @export
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL, ...) {
+  lgb.Dataset(data, label = label, reference = dataset, ...)
+}
+
+.as_booster <- function(bst) {
+  class(bst) <- c("lgb.Booster", class(bst))
+  bst
+}
+
+#' Train a model (reference lgb.train, R-package/R/lgb.train.R)
+#' @param params list of parameters (objective, metric, num_leaves, ...)
+#' @param data an lgb.Dataset
+#' @param nrounds number of boosting rounds
+#' @param valids named list of lgb.Dataset for evaluation
+#' @param early_stopping_rounds stop when no metric improves this long
+#' @param init_model path or Booster to continue from
+#' @export
+lgb.train <- function(params = list(), data, nrounds = 10,
+                      valids = list(), obj = NULL, eval = NULL,
+                      verbose = 1, record = TRUE, eval_freq = 1L,
+                      init_model = NULL, early_stopping_rounds = NULL,
+                      callbacks = list(), ...) {
+  lgb <- .lgb_py()
+  params <- c(params, list(...))
+  if (!is.null(obj)) params$objective <- obj
+  if (!is.null(eval)) params$metric <- eval
+  bst <- lgb$train(
+    params = params,
+    train_set = data,
+    num_boost_round = as.integer(nrounds),
+    valid_sets = unname(valids),
+    valid_names = if (length(valids)) names(valids) else NULL,
+    init_model = init_model,
+    early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL
+                            else as.integer(early_stopping_rounds),
+    verbose_eval = if (verbose > 0) as.integer(eval_freq) else FALSE
+  )
+  .as_booster(bst)
+}
+
+#' Cross validation (reference lgb.cv)
+#' @export
+lgb.cv <- function(params = list(), data, nrounds = 10, nfold = 3,
+                   stratified = TRUE, early_stopping_rounds = NULL,
+                   verbose = 1, ...) {
+  lgb <- .lgb_py()
+  params <- c(params, list(...))
+  res <- lgb$cv(
+    params = params,
+    train_set = data,
+    num_boost_round = as.integer(nrounds),
+    nfold = as.integer(nfold),
+    stratified = stratified,
+    early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL
+                            else as.integer(early_stopping_rounds),
+    verbose_eval = verbose > 0
+  )
+  reticulate::py_to_r(res)
+}
+
+#' Simplified one-call interface (reference lightgbm())
+#' @export
+lightgbm <- function(data, label = NULL, nrounds = 10,
+                     params = list(), ...) {
+  ds <- lgb.Dataset(data, label = label)
+  lgb.train(params = params, data = ds, nrounds = nrounds, ...)
+}
+
+#' @export
+predict.lgb.Booster <- function(object, data, rawscore = FALSE,
+                                predleaf = FALSE, predcontrib = FALSE,
+                                num_iteration = NULL, ...) {
+  out <- object$predict(
+    reticulate::r_to_py(as.matrix(data)),
+    raw_score = rawscore, pred_leaf = predleaf,
+    pred_contrib = predcontrib,
+    num_iteration = if (is.null(num_iteration)) NULL
+                    else as.integer(num_iteration))
+  reticulate::py_to_r(out)
+}
+
+#' @export
+print.lgb.Booster <- function(x, ...) {
+  cat("<lightgbm_tpu Booster: ", x$num_trees(), " trees>\n", sep = "")
+  invisible(x)
+}
+
+#' Save a model as the LightGBM v2 text format (reference lgb.save)
+#' @export
+lgb.save <- function(booster, filename, num_iteration = NULL) {
+  booster$save_model(filename,
+                     num_iteration = if (is.null(num_iteration)) NULL
+                                     else as.integer(num_iteration))
+  invisible(booster)
+}
+
+#' Load a text-format model — the reference's files load unchanged
+#' (reference lgb.load)
+#' @export
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  lgb <- .lgb_py()
+  bst <- lgb$Booster(model_file = filename, model_str = model_str)
+  .as_booster(bst)
+}
+
+#' JSON dump (reference lgb.dump)
+#' @export
+lgb.dump <- function(booster, num_iteration = NULL) {
+  booster$dump_model(num_iteration = if (is.null(num_iteration)) NULL
+                                     else as.integer(num_iteration))
+}
+
+#' Feature importance (reference lgb.importance)
+#' @param percentage rescale gains to fractions
+#' @export
+lgb.importance <- function(model, percentage = TRUE) {
+  gain <- reticulate::py_to_r(model$feature_importance("gain"))
+  split <- reticulate::py_to_r(model$feature_importance("split"))
+  nm <- reticulate::py_to_r(model$feature_name())
+  df <- data.frame(Feature = nm, Gain = as.numeric(gain),
+                   Cover = NA_real_, Frequency = as.numeric(split))
+  df <- df[order(-df$Gain), ]
+  if (percentage && sum(df$Gain) > 0) {
+    df$Gain <- df$Gain / sum(df$Gain)
+    df$Frequency <- df$Frequency / max(sum(df$Frequency), 1)
+  }
+  df
+}
+
+#' Tree structure as a data.frame (reference lgb.model.dt.tree)
+#' @export
+lgb.model.dt.tree <- function(model, num_iteration = NULL) {
+  dumped <- model$dump_model(
+    num_iteration = if (is.null(num_iteration)) NULL
+                    else as.integer(num_iteration))
+  info <- reticulate::py_to_r(dumped)
+  trees <- info$tree_info
+  rows <- do.call(rbind, lapply(seq_along(trees), function(i) {
+    flatten_node <- function(node, depth = 0L) {
+      this <- data.frame(
+        tree_index = i - 1L,
+        depth = depth,
+        split_feature = if (!is.null(node$split_feature))
+          node$split_feature else NA_integer_,
+        threshold = if (!is.null(node$threshold))
+          as.numeric(node$threshold)[1] else NA_real_,
+        split_gain = if (!is.null(node$split_gain))
+          node$split_gain else NA_real_,
+        value = if (!is.null(node$leaf_value))
+          node$leaf_value else
+          if (!is.null(node$internal_value)) node$internal_value
+          else NA_real_,
+        count = if (!is.null(node$leaf_count)) node$leaf_count else
+          if (!is.null(node$internal_count)) node$internal_count
+          else NA_real_
+      )
+      kids <- NULL
+      for (k in c("left_child", "right_child")) {
+        if (!is.null(node[[k]]) && is.list(node[[k]])) {
+          kids <- rbind(kids, flatten_node(node[[k]], depth + 1L))
+        }
+      }
+      rbind(this, kids)
+    }
+    flatten_node(trees[[i]]$tree_structure)
+  }))
+  rows
+}

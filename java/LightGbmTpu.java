@@ -1,0 +1,168 @@
+/**
+ * JVM binding for lightgbm_tpu.
+ *
+ * The reference exposes its engine to the JVM through a 100-line SWIG
+ * interface over the C API (reference: swig/lightgbmlib.i,
+ * CMakeLists.txt:185-214) — a thin marshalling layer for mmlspark.
+ * Here the engine is a Python/XLA runtime, so the equivalent thin
+ * boundary is the framework's config-file CLI (python -m lightgbm_tpu),
+ * which accepts exactly the reference CLI's config keys: the JVM side
+ * marshals parameters and matrices to files, the TPU side does all the
+ * work, and models cross the boundary in the LightGBM v2 text format
+ * both engines read and write.
+ */
+import java.io.BufferedWriter;
+import java.io.File;
+import java.io.IOException;
+import java.nio.charset.StandardCharsets;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.Map;
+
+public final class LightGbmTpu {
+
+    private String python = "python3";
+
+    public LightGbmTpu() {}
+
+    public LightGbmTpu(String pythonExecutable) {
+        this.python = pythonExecutable;
+    }
+
+    /** Train from a data file; returns the model file path. */
+    public Path train(Path trainData, Path validData,
+                      Map<String, String> params, Path outputModel)
+            throws IOException, InterruptedException {
+        List<String> argv = baseArgv();
+        argv.add("task=train");
+        argv.add("data=" + trainData);
+        if (validData != null) argv.add("valid_data=" + validData);
+        for (Map.Entry<String, String> e : params.entrySet()) {
+            argv.add(e.getKey() + "=" + e.getValue());
+        }
+        argv.add("output_model=" + outputModel);
+        run(argv);
+        return outputModel;
+    }
+
+    /** Train on an in-memory dense matrix. */
+    public Path train(double[][] features, double[] labels,
+                      Map<String, String> params, Path outputModel)
+            throws IOException, InterruptedException {
+        Path data = writeMatrix(features, labels);
+        try {
+            return train(data, null, params, outputModel);
+        } finally {
+            Files.deleteIfExists(data);
+        }
+    }
+
+    /** Predict rows of a data file with a saved model. */
+    public double[] predict(Path model, Path data,
+                            Map<String, String> params)
+            throws IOException, InterruptedException {
+        Path out = Files.createTempFile("lgbtpu_pred", ".txt");
+        List<String> argv = baseArgv();
+        argv.add("task=predict");
+        argv.add("input_model=" + model);
+        argv.add("data=" + data);
+        argv.add("output_result=" + out);
+        if (params != null) {
+            for (Map.Entry<String, String> e : params.entrySet()) {
+                argv.add(e.getKey() + "=" + e.getValue());
+            }
+        }
+        run(argv);
+        List<String> lines = Files.readAllLines(out,
+                StandardCharsets.UTF_8);
+        Files.deleteIfExists(out);
+        double[] preds = new double[lines.size()];
+        for (int i = 0; i < lines.size(); i++) {
+            // multiclass rows are tab-separated; keep the max prob here
+            String[] toks = lines.get(i).trim().split("\\s+");
+            double best = Double.NEGATIVE_INFINITY;
+            for (String t : toks) {
+                best = Math.max(best, Double.parseDouble(t));
+            }
+            preds[i] = toks.length == 1
+                    ? Double.parseDouble(toks[0]) : best;
+        }
+        return preds;
+    }
+
+    /** Predict an in-memory matrix. */
+    public double[] predict(Path model, double[][] features)
+            throws IOException, InterruptedException {
+        Path data = writeMatrix(features, null);
+        try {
+            return predict(model, data, null);
+        } finally {
+            Files.deleteIfExists(data);
+        }
+    }
+
+    private List<String> baseArgv() {
+        List<String> argv = new ArrayList<>();
+        argv.add(python);
+        argv.add("-m");
+        argv.add("lightgbm_tpu");
+        return argv;
+    }
+
+    private static Path writeMatrix(double[][] x, double[] y)
+            throws IOException {
+        Path f = Files.createTempFile("lgbtpu_data", ".csv");
+        try (BufferedWriter w = Files.newBufferedWriter(f,
+                StandardCharsets.UTF_8)) {
+            StringBuilder sb = new StringBuilder();
+            for (int i = 0; i < x.length; i++) {
+                sb.setLength(0);
+                sb.append(y == null ? 0.0 : y[i]);
+                for (double v : x[i]) sb.append(',').append(v);
+                sb.append('\n');
+                w.write(sb.toString());
+            }
+        }
+        return f;
+    }
+
+    private static void run(List<String> argv)
+            throws IOException, InterruptedException {
+        ProcessBuilder pb = new ProcessBuilder(argv);
+        pb.redirectErrorStream(true);
+        pb.redirectOutput(ProcessBuilder.Redirect.INHERIT);
+        Process p = pb.start();
+        int rc = p.waitFor();
+        if (rc != 0) {
+            throw new IOException("lightgbm_tpu exited with " + rc
+                    + " for: " + String.join(" ", argv));
+        }
+    }
+
+    public static void main(String[] args) throws Exception {
+        // smoke test: train + predict on a tiny synthetic problem
+        double[][] x = new double[400][4];
+        double[] y = new double[400];
+        java.util.Random r = new java.util.Random(7);
+        for (int i = 0; i < 400; i++) {
+            for (int j = 0; j < 4; j++) x[i][j] = r.nextGaussian();
+            y[i] = (x[i][0] + 0.5 * x[i][1] > 0) ? 1 : 0;
+        }
+        LightGbmTpu lgb = new LightGbmTpu();
+        Path model = Files.createTempFile("lgbtpu_model", ".txt");
+        Map<String, String> params = Map.of(
+                "objective", "binary", "num_leaves", "15",
+                "num_trees", "20", "min_data_in_leaf", "5");
+        lgb.train(x, y, params, model);
+        double[] p = lgb.predict(model, x);
+        int correct = 0;
+        for (int i = 0; i < 400; i++) {
+            if ((p[i] > 0.5 ? 1 : 0) == (int) y[i]) correct++;
+        }
+        System.out.println("accuracy=" + (correct / 400.0));
+        Files.deleteIfExists(model);
+        if (correct < 360) throw new AssertionError("quality too low");
+    }
+}

@@ -336,11 +336,7 @@ class Config:
     # accepted for reference compatibility but not implemented: warn
     # when set to a non-default value instead of silently ignoring
     _UNIMPLEMENTED = {
-        "two_round": False,
-        "pre_partition": False,
         "convert_model_language": "",
-        "machine_list_filename": "",
-        "machines": "",
     }
     # subsumed by the TPU design (documented substitutions, not gaps)
     _SUBSUMED = {
@@ -355,6 +351,10 @@ class Config:
         "gpu_use_dp": "see tpu_use_dp",
         "local_listen_port": "collectives ride ICI/DCN via XLA",
         "time_out": "collectives ride ICI/DCN via XLA",
+        "machine_list_filename": "host topology comes from the JAX "
+                                 "runtime (jax.distributed), not a "
+                                 "socket machine list",
+        "machines": "host topology comes from the JAX runtime",
     }
 
     def check_param_conflict(self) -> None:

@@ -60,7 +60,8 @@ class Metadata:
 
 def find_column_mappers(X: np.ndarray, config: Config,
                         categorical=(), total_rows: Optional[int] = None,
-                        columns: Optional[Sequence[int]] = None
+                        columns: Optional[Sequence[int]] = None,
+                        presampled: bool = False
                         ) -> List[Optional[BinMapper]]:
     """Sample rows and find a BinMapper per column (trivial ones
     included) — the shared bin-construction loop of
@@ -73,21 +74,26 @@ def find_column_mappers(X: np.ndarray, config: Config,
     shard must use the SAME total or their bin boundaries diverge.
     ``columns`` restricts the search to a subset (the distributed
     owner-rule workload split, dataset_loader.cpp:434-466); unowned
-    entries come back as None."""
+    entries come back as None. ``presampled``: ``X`` already IS the
+    sample of a ``total_rows``-row dataset (two-round loading) — skip
+    re-sampling, scale only the min_data filter."""
     X = np.asarray(X)
     n, nf = X.shape
     cfg = config
     total = n if total_rows is None else max(int(total_rows), 1)
-    budget = cfg.bin_construct_sample_cnt
-    if total > n > 0:
-        budget = max(budget * n // total, 1)    # this shard's share
-    sample_cnt = min(budget, n)
-    rng = np.random.default_rng(cfg.data_random_seed)
-    if sample_cnt < n:
-        idx = np.sort(rng.choice(n, sample_cnt, replace=False))
-        sample = X[idx]
-    else:
+    if presampled:
         sample = X
+    else:
+        budget = cfg.bin_construct_sample_cnt
+        if total > n > 0:
+            budget = max(budget * n // total, 1)   # this shard's share
+        sample_cnt = min(budget, n)
+        rng = np.random.default_rng(cfg.data_random_seed)
+        if sample_cnt < n:
+            idx = np.sort(rng.choice(n, sample_cnt, replace=False))
+            sample = X[idx]
+        else:
+            sample = X
     snum = sample.shape[0]
     filter_cnt = 0
     if cfg.min_data_in_leaf > 0 and total > 0:

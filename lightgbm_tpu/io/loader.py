@@ -107,15 +107,190 @@ class DatasetLoader:
             log.info("Loading dataset from binary cache %s", bin_cache)
             return TpuDataset.load_binary(bin_cache, cfg)
 
-        X, meta, names, categorical = self._parse_with_metadata(filename)
-        ds = TpuDataset(cfg)
-        ds.construct_from_matrix(
-            X, meta, categorical=categorical, reference=reference,
-            feature_names=names or None)
+        if cfg.two_round:
+            ds = self._load_two_round(filename, reference)
+        else:
+            X, meta, names, categorical = self._parse_with_metadata(
+                filename)
+            ds = TpuDataset(cfg)
+            ds.construct_from_matrix(
+                X, meta, categorical=categorical, reference=reference,
+                feature_names=names or None)
         log.info("Finished loading %s: %d rows, %d used features",
                  filename, ds.num_data, ds.num_features)
         if cfg.save_binary and reference is None:
             ds.save_binary(bin_cache)
+        return ds
+
+    # -- two-round (memory-light) loading ------------------------------------
+
+    def _data_lines(self, filename: str):
+        """Yield data lines: header/comments/blanks skipped
+        (TextReader parity, utils/text_reader.h)."""
+        header_pending = self.config.header
+        with open(filename) as fh:
+            for ln in fh:
+                t = ln.strip()
+                if not t or t.startswith("#"):
+                    continue
+                if header_pending:
+                    header_pending = False
+                    continue
+                yield ln.rstrip("\r\n")
+
+    def _load_two_round(self, filename: str,
+                        reference: Optional[TpuDataset] = None,
+                        chunk_rows: int = 1 << 18) -> TpuDataset:
+        """two_round=true: the reference's memory-light path
+        (dataset_loader.cpp LoadFromFile with two_round —
+        SampleTextDataFromFile then a second streaming pass,
+        :196-235/:657-704). Pass 1 counts rows and parses only a sampled
+        subset to build the bin mappers; pass 2 re-streams the file in
+        ``chunk_rows`` blocks, binning each block straight into the
+        uint8 matrix — the full float matrix never exists."""
+        cfg = self.config
+        from .dataset import find_column_mappers
+        from .parser import (_first_data_lines, detect_format,
+                             parse_delimited, parse_libsvm)
+        first, head = _first_data_lines(filename, 2, cfg.header, True)
+        fmt = detect_format(first)
+        delim = "\t" if fmt == "tsv" else ","
+        full_names = ([t.strip() for t in head.split(delim)]
+                      if cfg.header and head else [])
+        label_all = _parse_column_spec(
+            cfg.label_column, full_names,
+            "label") if cfg.label_column else 0
+        if label_all < 0:
+            label_all = 0
+
+        def parse_lines(lines, ncol_hint=0):
+            if fmt == "libsvm":
+                return parse_libsvm(lines, label_all, ncol_hint)
+            return parse_delimited(lines, delim, label_all)
+
+        # pass 1 (ONE scan): count rows, reservoir-sample the bin-
+        # construction lines, and for libsvm track the true column
+        # count across the WHOLE file (features absent from the sample
+        # must still get bin slots — trivial, but present)
+        cap = max(int(cfg.bin_construct_sample_cnt), 1)
+        rng = np.random.default_rng(cfg.data_random_seed)
+        reservoir: List[str] = []
+        n = 0
+        libsvm_maxidx = -1
+        for ln in self._data_lines(filename):
+            if fmt == "libsvm":
+                # indices ascend in well-formed libsvm rows: the last
+                # pair carries the row's max feature index
+                tail = ln.rstrip().rsplit(None, 1)
+                if len(tail) == 2 and ":" in tail[1]:
+                    try:
+                        libsvm_maxidx = max(
+                            libsvm_maxidx,
+                            int(tail[1].split(":", 1)[0]))
+                    except ValueError:
+                        pass
+            if n < cap:
+                reservoir.append(ln)
+            else:
+                j = int(rng.integers(0, n + 1))
+                if j < cap:
+                    reservoir[j] = ln
+            n += 1
+        if n == 0:
+            log.fatal(f"Data file {filename} is empty")
+        sparsed = parse_lines(reservoir,
+                              libsvm_maxidx + 1 if fmt == "libsvm"
+                              else 0)
+        ncol = max(sparsed.num_columns,
+                   libsvm_maxidx + 1 if fmt == "libsvm" else 0)
+        # rows missing trailing delimited columns bin as missing (the
+        # one-round parser's semantics); absent libsvm pairs are 0
+        pad_value = 0.0 if fmt == "libsvm" else np.nan
+
+        feat_names = list(full_names)
+        if feat_names and sparsed.label is not None \
+                and len(feat_names) > ncol:
+            feat_names.pop(max(label_all, 0))
+        (weight_idx, group_idx, keep_cols, categorical,
+         feat_names) = self._resolve_columns(feat_names, ncol)
+
+        ds = TpuDataset(cfg)
+        ds.num_data = n
+        ds.num_total_features = len(keep_cols)
+        ds.feature_names = (feat_names if feat_names else
+                            [f"Column_{i}"
+                             for i in range(len(keep_cols))])
+        if reference is not None:
+            ds._reference = reference
+            ds.mappers = reference.mappers
+            ds.used_feature_map = reference.used_feature_map
+            ds.real_to_inner = reference.real_to_inner
+            ds.max_bin_global = reference.max_bin_global
+            ds.feature_names = reference.feature_names
+            ds.num_total_features = reference.num_total_features
+        else:
+            Xs = sparsed.values
+            if Xs.shape[1] < ncol:
+                Xs = np.pad(Xs, ((0, 0), (0, ncol - Xs.shape[1])),
+                            constant_values=pad_value)
+            ds._set_mappers(find_column_mappers(
+                Xs[:, keep_cols], cfg, categorical,
+                total_rows=n, presampled=True))
+
+        # pass 2: stream + bin
+        f_used = max(len(ds.mappers), 1)
+        dtype = np.uint8 if ds.max_bin_global <= 256 else np.int32
+        bins = np.zeros((n, f_used), dtype)
+        label = np.zeros(n, np.float32)
+        weight = np.zeros(n, np.float32) if weight_idx >= 0 else None
+        group_col = np.zeros(n, np.float64) if group_idx >= 0 else None
+        row = 0
+        buf: List[str] = []
+
+        def flush(buf):
+            nonlocal row
+            if not buf:
+                return
+            p = parse_lines(buf, ncol)
+            Xc = p.values
+            if Xc.shape[1] < ncol:
+                # delimited rows missing trailing columns -> missing
+                # (NaN, matching the one-round parser); absent libsvm
+                # pairs -> 0 (libsvm sparse semantics)
+                Xc = np.pad(Xc, ((0, 0), (0, ncol - Xc.shape[1])),
+                            constant_values=pad_value)
+            elif Xc.shape[1] > ncol:
+                log.warning("two_round: row block has %d columns, "
+                            "expected %d; extra columns ignored",
+                            Xc.shape[1], ncol)
+                Xc = Xc[:, :ncol]
+            k = Xc.shape[0]
+            if p.label is not None:
+                label[row:row + k] = p.label
+            if weight is not None:
+                weight[row:row + k] = Xc[:, weight_idx]
+            if group_col is not None:
+                group_col[row:row + k] = Xc[:, group_idx]
+            Xf = Xc[:, keep_cols]
+            for i, real in enumerate(ds.used_feature_map):
+                bins[row:row + k, i] = \
+                    ds.mappers[i].value_to_bin(Xf[:, real]).astype(dtype)
+            row += k
+
+        for ln in self._data_lines(filename):
+            buf.append(ln)
+            if len(buf) >= chunk_rows:
+                flush(buf)
+                buf = []
+        flush(buf)
+        ds.bins = bins
+        ds.metadata = self._assemble_metadata(
+            filename, label if sparsed.label is not None else None,
+            weight, group_col)
+        ds.metadata.check_or_partition(n)
+        ds._apply_efb()     # handles both fresh and reference bundles
+        log.info("two_round load: %d rows binned in %d-row blocks",
+                 n, chunk_rows)
         return ds
 
     def _parse_with_metadata(self, filename: str
@@ -143,35 +318,53 @@ class DatasetLoader:
         X = parsed.values
         label = parsed.label
 
-        # weight/group/ignore indices do NOT count the label column
-        # (docs/Parameters: "index starts from 0 ... doesn't count the
-        # label column"); names resolve against the post-label layout.
-        feat_names = list(header_names)
-        weight_idx = _parse_column_spec(cfg.weight_column, feat_names,
-                                        "weight") if cfg.weight_column else -1
-        group_idx = _parse_column_spec(cfg.group_column, feat_names,
-                                       "group") if cfg.group_column else -1
+        (weight_idx, group_idx, keep_cols, categorical,
+         feat_names) = self._resolve_columns(list(header_names),
+                                             X.shape[1])
+        weight = X[:, weight_idx].astype(np.float32) if weight_idx >= 0 \
+            else None
+        group_col = X[:, group_idx] if group_idx >= 0 else None
+        if len(keep_cols) != X.shape[1]:
+            X = X[:, keep_cols]
+
+        meta = self._assemble_metadata(filename, label, weight, group_col)
+        return X, meta, feat_names, categorical
+
+    def _resolve_columns(self, feat_names: List[str], ncol: int):
+        """weight/group/ignore/categorical column resolution. Indices
+        do NOT count the label column (docs/Parameters: "index starts
+        from 0 ... doesn't count the label column"); names resolve
+        against the post-label layout. Returns
+        (weight_idx, group_idx, keep_cols, categorical, kept_names)
+        with ``categorical`` remapped to the kept layout."""
+        cfg = self.config
+        weight_idx = _parse_column_spec(
+            cfg.weight_column, feat_names,
+            "weight") if cfg.weight_column else -1
+        group_idx = _parse_column_spec(
+            cfg.group_column, feat_names,
+            "group") if cfg.group_column else -1
         ignore = _parse_multi_column_spec(cfg.ignore_column, feat_names,
                                           "ignore")
         categorical = _parse_multi_column_spec(
             cfg.categorical_feature, feat_names, "categorical")
-
-        weight = X[:, weight_idx].astype(np.float32) if weight_idx >= 0 \
-            else None
-        group_col = X[:, group_idx] if group_idx >= 0 else None
-
         drop = sorted({i for i in (weight_idx, group_idx) if i >= 0}
-                      | {i for i in ignore if 0 <= i < X.shape[1]})
-        if drop:
-            keep = [i for i in range(X.shape[1]) if i not in drop]
-            X = X[:, keep]
-            remap = {old: new for new, old in enumerate(keep)}
-            categorical = {remap[c] for c in categorical if c in remap}
-            if feat_names:
-                feat_names = [feat_names[i] for i in keep]
+                      | {i for i in ignore if 0 <= i < ncol})
+        keep_cols = [i for i in range(ncol) if i not in drop]
+        remap = {old: new for new, old in enumerate(keep_cols)}
+        categorical = sorted({remap[c] for c in categorical
+                              if c in remap})
+        if feat_names:
+            feat_names = [feat_names[i] for i in keep_cols
+                          if i < len(feat_names)]
+        return weight_idx, group_idx, keep_cols, categorical, feat_names
 
-        # sidecars (metadata.cpp:324-431): <file>.weight, <file>.query,
-        # init scores from config or <file>.init
+    def _assemble_metadata(self, filename: str, label, weight,
+                           group_col) -> Metadata:
+        """Metadata from in-file columns + sidecar files
+        (metadata.cpp:324-431): <file>.weight, <file>.query, init scores
+        from config or <file>.init."""
+        cfg = self.config
         if weight is None:
             w = _read_float_file(filename + ".weight")
             if w is not None:
@@ -199,10 +392,8 @@ class DatasetLoader:
             if init_score.ndim == 2:       # [N, K] column-major flatten
                 init_score = init_score.T.reshape(-1)
             log.info("Loading initial scores from %s", init_path)
-
-        meta = Metadata(label=label, weight=weight, group=group,
+        return Metadata(label=label, weight=weight, group=group,
                         init_score=init_score)
-        return X, meta, feat_names, sorted(categorical)
 
     # -- prediction-side text load ------------------------------------------
 
