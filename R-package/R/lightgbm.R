@@ -31,8 +31,10 @@ lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
   params <- list(...)
   cat_py <- NULL
   if (!is.null(categorical_feature)) {
+    # always a LIST: reticulate sends a length-1 vector as a python
+    # scalar, which the python Dataset would silently ignore
     cat_py <- if (is.numeric(categorical_feature)) {
-      as.integer(categorical_feature - 1L)   # R 1-based -> 0-based
+      as.list(as.integer(categorical_feature - 1L))  # 1-based -> 0-based
     } else {
       as.list(categorical_feature)
     }
@@ -130,7 +132,7 @@ predict.lgb.Booster <- function(object, data, rawscore = FALSE,
     reticulate::r_to_py(as.matrix(data)),
     raw_score = rawscore, pred_leaf = predleaf,
     pred_contrib = predcontrib,
-    num_iteration = if (is.null(num_iteration)) NULL
+    num_iteration = if (is.null(num_iteration)) -1L
                     else as.integer(num_iteration))
   reticulate::py_to_r(out)
 }
@@ -145,7 +147,7 @@ print.lgb.Booster <- function(x, ...) {
 #' @export
 lgb.save <- function(booster, filename, num_iteration = NULL) {
   booster$save_model(filename,
-                     num_iteration = if (is.null(num_iteration)) NULL
+                     num_iteration = if (is.null(num_iteration)) -1L
                                      else as.integer(num_iteration))
   invisible(booster)
 }
@@ -162,7 +164,7 @@ lgb.load <- function(filename = NULL, model_str = NULL) {
 #' JSON dump (reference lgb.dump)
 #' @export
 lgb.dump <- function(booster, num_iteration = NULL) {
-  booster$dump_model(num_iteration = if (is.null(num_iteration)) NULL
+  booster$dump_model(num_iteration = if (is.null(num_iteration)) -1L
                                      else as.integer(num_iteration))
 }
 
@@ -187,7 +189,7 @@ lgb.importance <- function(model, percentage = TRUE) {
 #' @export
 lgb.model.dt.tree <- function(model, num_iteration = NULL) {
   dumped <- model$dump_model(
-    num_iteration = if (is.null(num_iteration)) NULL
+    num_iteration = if (is.null(num_iteration)) -1L
                     else as.integer(num_iteration))
   info <- reticulate::py_to_r(dumped)
   trees <- info$tree_info

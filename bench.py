@@ -47,6 +47,9 @@ def main():
     ap.add_argument("--max-bin", type=int, default=63)
     ap.add_argument("--quick", action="store_true",
                     help="tiny smoke run (64k rows, 20 iters)")
+    ap.add_argument("--no-quant", action="store_true",
+                    help="disable int8 histogram quantization "
+                         "(f32-grade hi/lo accumulation instead)")
     args = ap.parse_args()
     if args.quick:
         args.rows, args.iters, args.leaves = 65_536, 20, 63
@@ -67,6 +70,10 @@ def main():
         "learning_rate": 0.1, "min_data_in_leaf": 20,
         # run every iteration on device; no periodic host sync inside
         "tpu_stop_check_interval": 10_000,
+        # int8 gradient quantization: exact int32 histogram sums of
+        # stochastically-rounded int8 g/h at 2x MXU rate (the train-AUC
+        # printed below shows quality parity with the f32 path)
+        "tpu_quantized_hist": not args.no_quant,
     })
     t0 = time.time()
     ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))

@@ -267,6 +267,13 @@ class Config:
     # strict leaf-wise order when the leaf budget binds mid-wave; set
     # tpu_wave_size=1 for exact reference parity.
     tpu_wave_size: int = 0
+    # int8 gradient quantization (analog of modern LightGBM's quantized
+    # training): g/h are stochastically rounded to integers in
+    # [-127, 127] per tree and histograms accumulate exactly in int32
+    # int8 MXU products — 2x the bf16 rate and a 42-leaf wave
+    # (3 channels). Costs ~1e-3 AUC-grade noise on the split gains;
+    # serial tree_learner without EFB bundles only.
+    tpu_quantized_hist: bool = False
     # iterations between host checks for the "no more splits" stop
     # (gbdt.cpp:393-409); device→host reads are high-latency, so the stop
     # is detected periodically instead of every iteration

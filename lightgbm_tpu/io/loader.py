@@ -272,9 +272,7 @@ class DatasetLoader:
             if group_col is not None:
                 group_col[row:row + k] = Xc[:, group_idx]
             Xf = Xc[:, keep_cols]
-            for i, real in enumerate(ds.used_feature_map):
-                bins[row:row + k, i] = \
-                    ds.mappers[i].value_to_bin(Xf[:, real]).astype(dtype)
+            bins[row:row + k] = ds.bin_rows(Xf)
             row += k
 
         for ln in self._data_lines(filename):

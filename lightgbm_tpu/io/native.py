@@ -42,7 +42,8 @@ def _load() -> Optional[ctypes.CDLL]:
             return None
         try:
             subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, src],
+                ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                 "-o", _SO, src],
                 check=True, capture_output=True, timeout=120)
         except (OSError, subprocess.SubprocessError) as e:
             log.debug("native parser build unavailable (%s); using the "
@@ -52,6 +53,25 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_SO)
     except OSError:
         return None
+    try:
+        _bind(lib)
+    except AttributeError:
+        # stale cached .so from an older version missing a symbol:
+        # rebuild once, else fall back to the python paths
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                 "-o", _SO, src],
+                check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO)
+            _bind(lib)
+        except (OSError, subprocess.SubprocessError, AttributeError):
+            return None
+    _lib = lib
+    return _lib
+
+
+def _bind(lib) -> None:
     lib.lgbm_tpu_parse_count.argtypes = [
         ctypes.c_char_p, ctypes.c_int,
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
@@ -62,8 +82,13 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_float),
         ctypes.c_int64, ctypes.c_int32]
     lib.lgbm_tpu_parse_fill.restype = ctypes.c_int
-    _lib = lib
-    return _lib
+    lib.lgbm_tpu_bin_columns.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32]
+    lib.lgbm_tpu_bin_columns.restype = ctypes.c_int
 
 
 def available() -> bool:
@@ -110,3 +135,50 @@ def parse_file_native(filename: str, header: bool, label_idx: int
         # rc 3 = ragged rows: the python parser pads and warns
         return None
     return values, labels, f
+
+
+def bin_columns_native(X: np.ndarray, col_idx: np.ndarray,
+                       bounds_list, r_len: np.ndarray,
+                       nan_bin: np.ndarray) -> "Optional[np.ndarray]":
+    """Bulk BinMapper::ValueToBin over numerical columns (threaded C++).
+
+    X row-major [n, ncol] f32/f64; col_idx [f] source column per used
+    feature; bounds_list: per-feature float64 upper-bound arrays;
+    r_len[f]: searchsorted range; nan_bin[f]: NaN's bin or -1.
+    Returns [n, f] uint8 or None when the native library is absent.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    X = np.ascontiguousarray(X)
+    if X.dtype == np.float32:
+        xdtype = 1
+    elif X.dtype == np.float64:
+        xdtype = 0
+    else:
+        return None
+    n, ncol = X.shape
+    f = len(bounds_list)
+    bounds = (np.concatenate(bounds_list).astype(np.float64)
+              if f else np.zeros(0, np.float64))
+    off = np.zeros(f + 1, np.int64)
+    np.cumsum([len(b) for b in bounds_list], out=off[1:])
+    out = np.empty((n, f), np.uint8)
+    col_idx = np.ascontiguousarray(col_idx, np.int32)
+    r_len = np.ascontiguousarray(r_len, np.int32)
+    nan_bin = np.ascontiguousarray(nan_bin, np.int32)
+    bounds = np.ascontiguousarray(bounds)
+    off = np.ascontiguousarray(off)
+    nthreads = min(16, os.cpu_count() or 1)
+    rc = lib.lgbm_tpu_bin_columns(
+        X.ctypes.data_as(ctypes.c_void_p), np.int64(n), np.int32(ncol),
+        np.int32(xdtype),
+        col_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        np.int32(f),
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        r_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        nan_bin.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        np.int32(nthreads))
+    return out if rc == 0 else None

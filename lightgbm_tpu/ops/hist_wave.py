@@ -113,7 +113,8 @@ def wave_histogram_xla(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 # ---------------------------------------------------------------------------
 
 def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
-                      groups, group_sz, hilo, exact_dot=False):
+                      groups, group_sz, hilo, exact_dot=False,
+                      int8=False):
     """One grid step = one row chunk; accumulates into out_ref (VMEM).
 
     Every tensor keeps ROWS ON THE LANE AXIS — no relayouts anywhere:
@@ -144,7 +145,12 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
     lvec = ghl_ref[2:3, :]
     wl = wl_ref[...]                                    # [Wp, 1]
     mw = ((lvec == wl[:W]) & (wl[:W] >= 0.0)).astype(jnp.float32)
-    if hilo:                                            # mw: [W, Ct]
+    if int8:
+        # quantized mode: gvec/hvec carry integer values in [-127, 127]
+        # (tpu_quantized_hist, see wave_grower); int8 x int8 -> int32
+        # MXU products are exact and run at 2x the bf16 rate
+        w_rows = jnp.concatenate([mw * gvec, mw * hvec, mw], axis=0)
+    elif hilo:                                          # mw: [W, Ct]
         g_hi, g_lo = _bf16_split(gvec)
         h_hi, h_lo = _bf16_split(hvec)
         w_rows = jnp.concatenate(
@@ -162,8 +168,14 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
     # bf16 operands halve the one-hot tiles' footprint; numerically
     # identical to the DEFAULT bf16 MXU pass (interpret mode keeps f32
     # for the HIGHEST-precision CPU oracle)
-    oh_dt = jnp.float32 if exact_dot else jnp.bfloat16
-    w_mm = w_rows if exact_dot else w_rows.astype(jnp.bfloat16)
+    if int8:
+        oh_dt = jnp.int8
+        w_mm = w_rows.astype(jnp.int8)
+        acc_dt = jnp.int32
+    else:
+        oh_dt = jnp.float32 if exact_dot else jnp.bfloat16
+        w_mm = w_rows if exact_dot else w_rows.astype(jnp.bfloat16)
+        acc_dt = jnp.float32
 
     for p in range(groups):
         # per-feature one-hot blocks concatenated on ALIGNED sublane
@@ -189,9 +201,10 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
         # different split-precision numerics, so force HIGHEST there.
         acc = jax.lax.dot_general(
             oh_t, w_mm, dimension_numbers=(((1,), (1,)), ((), ())),
-            precision=(jax.lax.Precision.HIGHEST if exact_dot
+            precision=(None if int8
+                       else jax.lax.Precision.HIGHEST if exact_dot
                        else jax.lax.Precision.DEFAULT),
-            preferred_element_type=jnp.float32)         # [gb, 128]
+            preferred_element_type=acc_dt)              # [gb, 128]
         gb_pad = out_ref.shape[1]
         if gb_pad != gb:
             acc = jnp.pad(acc, ((0, gb_pad - gb), (0, 0)))
@@ -202,7 +215,8 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
                    static_argnames=("num_bins", "chunk", "interpret",
                                     "precision"))
 def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
-                          chunk=2048, interpret=False, precision="highest"):
+                          chunk=2048, interpret=False, precision="highest",
+                          gh_scale=None):
     """Pallas wave histogram — same contract as wave_histogram_xla.
 
     Grid over row chunks; per chunk the kernel builds the leaf-membership
@@ -214,16 +228,25 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 
     precision="highest" uses the bf16 hi/lo weight decomposition (exact
     products, ~f32-sum accuracy, needs wave W <= 25); "default" uses
-    single bf16 weights (W <= 42, grad/hess round to bf16).
+    single bf16 weights (W <= 42, grad/hess round to bf16);
+    "int8" expects PRE-QUANTIZED integer-valued g/h in [-127, 127]
+    (tpu_quantized_hist) and accumulates exactly in int32 at 2x MXU
+    rate (W <= 42) — ``gh_scale`` = (g_scale, h_scale) dequantizes the
+    output back to f32 sums.
     """
     F, n = bins_t.shape
     W = int(wave_leaves.shape[0])
     B = num_bins
-    hilo = precision != "default"
+    int8 = precision == "int8"
+    hilo = precision == "highest"
     ncol = (5 if hilo else 3) * W
     if ncol > 128:
         raise NotImplementedError(
             f"wave_size {W} needs {5 if hilo else 3}W <= 128 lanes")
+    if int8 and 127 * (n + (-n) % chunk) >= 2 ** 31:
+        raise NotImplementedError(
+            "int8 histogram sums could overflow int32 beyond ~16.9M "
+            "rows; disable tpu_quantized_hist")
     Bp = _round_up(B, 8)               # aligned per-feature row stride
     group_sz = max(1, 128 // Bp)       # features per matmul M-tile
     gb = group_sz * Bp
@@ -249,7 +272,8 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 
     kernel = functools.partial(
         _wave_hist_kernel, F=F, B=B, W=W, groups=groups,
-        group_sz=group_sz, hilo=hilo, exact_dot=interpret)
+        group_sz=group_sz, hilo=hilo, exact_dot=interpret and not int8,
+        int8=int8)
 
     out = pl.pallas_call(
         kernel,
@@ -264,7 +288,8 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
         ],
         out_specs=pl.BlockSpec((groups, gb_pad, 128), lambda i: (0, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((groups, gb_pad, 128), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(
+            (groups, gb_pad, 128), jnp.int32 if int8 else jnp.float32),
         # the unrolled group loop's temporaries exceed the 16 MB default
         # scoped-vmem cap; v5e has 128 MB physical VMEM
         compiler_params=pltpu.CompilerParams(
@@ -282,22 +307,40 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                          out[:, :, 2] + out[:, :, 3],     # h = hi + lo
                          out[:, :, 4]], axis=2)           # count
         return out.transpose(3, 0, 1, 2)
-    return out.reshape(F, B, 3, W).transpose(3, 0, 1, 2)
+    out = out.reshape(F, B, 3, W).transpose(3, 0, 1, 2)
+    if int8:
+        out = out.astype(jnp.float32) * _qscale_vec(gh_scale)
+    return out
+
+
+def _qscale_vec(gh_scale):
+    """[3] channel dequantization vector (g_scale, h_scale, 1)."""
+    sg, sh = gh_scale
+    return jnp.stack([jnp.float32(sg), jnp.float32(sh),
+                      jnp.float32(1.0)])
 
 
 def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
-                   chunk=0, use_pallas=None, precision="highest"):
-    """Dispatch: Pallas on TPU, XLA elsewhere (or force via use_pallas)."""
+                   chunk=0, use_pallas=None, precision="highest",
+                   gh_scale=None):
+    """Dispatch: Pallas on TPU, XLA elsewhere (or force via use_pallas).
+
+    precision="int8": g/h are integer-valued (quantized) and gh_scale
+    dequantizes the sums; the XLA scatter path is exact on integer
+    floats as-is, so only the Pallas kernel switches dtype."""
     if use_pallas is None:
         from ..utils.device import on_tpu
         use_pallas = on_tpu()
     if use_pallas:
         return wave_histogram_pallas(
             bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
-            chunk=chunk or 8192, precision=precision)
-    return wave_histogram_xla(
+            chunk=chunk or 8192, precision=precision, gh_scale=gh_scale)
+    out = wave_histogram_xla(
         bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
-        chunk=0, precision=precision)
+        chunk=0, precision="highest")
+    if precision == "int8":
+        out = out * _qscale_vec(gh_scale)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -313,11 +356,12 @@ TBL_ROWS = 24           # padded to an int32 sublane multiple
 
 FUSED_MAX_WAVE = 32          # 4 channels x W <= 128 MXU lanes (bf16 h)
 FUSED_MAX_WAVE_HILO = 24     # 5 channels, kept a multiple of 8
+FUSED_MAX_WAVE_INT8 = 42     # 3 channels (int8 gq/hq/count)
 
 
 def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
                   hist_ref, leaf_out_ref, *, F, B, W, groups, group_sz,
-                  hilo, exact_dot=False):
+                  hilo, exact_dot=False, int8=False):
     """One grid step: partition one row chunk by the wave's W splits,
     then accumulate the wave's smaller-child histograms — ONE data pass.
 
@@ -407,13 +451,19 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     mvec = ghm_ref[2:3, :]
     m = ((leaf_new == small_c.astype(i32))
          & (small_c >= 0)).astype(jnp.float32)              # [W, Ct]
-    g_hi, g_lo = _bf16_split(gvec)
-    if hilo:
+    if int8:
+        # quantized mode (tpu_quantized_hist): gvec/hvec hold integers
+        # in [-127, 127]; int8 MXU products, exact int32 sums, 2x rate
+        w_rows = jnp.concatenate(
+            [m * gvec, m * hvec, m * mvec], axis=0)          # [3W, Ct]
+    elif hilo:
+        g_hi, g_lo = _bf16_split(gvec)
         h_hi, h_lo = _bf16_split(hvec)
         w_rows = jnp.concatenate(
             [m * g_hi, m * g_lo, m * h_hi, m * h_lo, m * mvec],
             axis=0)                                          # [5W, Ct]
     else:
+        g_hi, g_lo = _bf16_split(gvec)
         w_rows = jnp.concatenate(
             [m * g_hi, m * g_lo, m * hvec, m * mvec], axis=0)  # [4W, Ct]
     nrow = w_rows.shape[0]
@@ -427,8 +477,14 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     # bf16 operands halve the one-hot tile's VMEM/register footprint;
     # numerically identical to the DEFAULT bf16 MXU pass (interpret
     # mode keeps f32 for the HIGHEST-precision CPU oracle)
-    oh_dt = jnp.float32 if exact_dot else jnp.bfloat16
-    w_mm = w_rows if exact_dot else w_rows.astype(jnp.bfloat16)
+    if int8:
+        oh_dt = jnp.int8
+        w_mm = w_rows.astype(jnp.int8)
+        acc_dt = jnp.int32
+    else:
+        oh_dt = jnp.float32 if exact_dot else jnp.bfloat16
+        w_mm = w_rows if exact_dot else w_rows.astype(jnp.bfloat16)
+        acc_dt = jnp.float32
     for p in range(groups):
         blocks = []
         for sidx in range(group_sz):
@@ -443,9 +499,10 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
                 else jnp.concatenate(blocks, axis=0))
         acc = jax.lax.dot_general(
             oh_t, w_mm, dimension_numbers=(((1,), (1,)), ((), ())),
-            precision=(jax.lax.Precision.HIGHEST if exact_dot
+            precision=(None if int8
+                       else jax.lax.Precision.HIGHEST if exact_dot
                        else jax.lax.Precision.DEFAULT),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=acc_dt)
         gb_pad = hist_ref.shape[1]
         if gb_pad != gb:
             acc = jnp.pad(acc, ((0, gb_pad - gb), (0, 0)))
@@ -457,7 +514,8 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
 def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
                                      leaf_ids, tbl, *, num_bins,
                                      chunk=2048, interpret=False,
-                                     precision="highest"):
+                                     precision="highest",
+                                     gh_scale=None):
     """Partition one wave + build its smaller-child histograms in ONE
     data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]).
 
@@ -465,15 +523,25 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     fields + 8 categorical bitset words). g/h must be pre-masked by
     sample_mask; counts use the mask channel. Only the feature-major
     bins are read — the partition selects feature rows.
+
+    precision="int8": g/h are pre-quantized integer-valued floats
+    (tpu_quantized_hist); sums accumulate exactly in int32 at 2x MXU
+    rate and ``gh_scale`` dequantizes the output.
     """
     F, n = bins_t.shape
     W = int(tbl.shape[1])
     B = num_bins
-    hilo = precision != "default"
-    cap = FUSED_MAX_WAVE_HILO if hilo else FUSED_MAX_WAVE
+    int8 = precision == "int8"
+    hilo = precision == "highest"
+    cap = (FUSED_MAX_WAVE_INT8 if int8
+           else FUSED_MAX_WAVE_HILO if hilo else FUSED_MAX_WAVE)
     if W > cap:
         raise NotImplementedError(f"fused wave needs W <= {cap}")
-    nchan = 5 if hilo else 4
+    if int8 and 127 * (n + (-n) % chunk) >= 2 ** 31:
+        raise NotImplementedError(
+            "int8 histogram sums could overflow int32 beyond ~16.9M "
+            "rows; disable tpu_quantized_hist")
+    nchan = 3 if int8 else 5 if hilo else 4
     Bp = _round_up(B, 8)
     group_sz = max(1, 128 // Bp)
     gb = group_sz * Bp
@@ -501,7 +569,7 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
 
     kernel = functools.partial(
         _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz,
-        hilo=hilo, exact_dot=interpret)
+        hilo=hilo, exact_dot=interpret and not int8, int8=int8)
 
     hist, leaf_out = pl.pallas_call(
         kernel,
@@ -523,7 +591,8 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
                          memory_space=pltpu.VMEM),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((groups, gb_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((groups, gb_pad, 128),
+                                 jnp.int32 if int8 else jnp.float32),
             jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
         ),
         compiler_params=pltpu.CompilerParams(
@@ -537,6 +606,10 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     hist = hist[:, :gb, :nchan * W].reshape(
         groups * group_sz, Bp, nchan * W)[:F, :B]
     hist = hist.reshape(F, B, nchan, W)
+    if int8:
+        hist = hist.astype(jnp.float32).transpose(0, 1, 3, 2) \
+            * _qscale_vec(gh_scale)                        # [F,B,W,3]
+        return leaf_out[0, :n], hist.transpose(2, 0, 1, 3)
     if hilo:
         hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo
                           hist[:, :, 2] + hist[:, :, 3],   # h = hi+lo

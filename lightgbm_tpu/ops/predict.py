@@ -45,10 +45,63 @@ def replay_partition(rec, bins_t, meta: FeatureMeta):
     return jax.lax.fori_loop(0, num_splits, body, leaf_ids)
 
 
+def _leaf_gather_kernel(tbl_ref, leaf_ref, out_ref, *, L):
+    """out[r, c] = tbl[leaf[r, c]] (-0.0 for ids outside [0, L)).
+
+    XLA lowers a [L]-table gather by 11M indices to a ~1.5 GB/s scalar
+    loop (measured 7.7 ms per 1M rows — 14% of a whole boosting
+    iteration); this kernel instead sweeps the table once with full-
+    width VPU selects: L sequential compare+selects over an [8, C]
+    tile, with the table in SMEM for scalar reads."""
+    leaf = leaf_ref[...]                                # [8, C] i32
+    def body(l, acc):
+        return jnp.where(leaf == l, tbl_ref[0, l], acc)
+    out_ref[...] = jax.lax.fori_loop(
+        0, L, body, jnp.zeros_like(out_ref))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def leaf_gather_pallas(table, leaf_ids, *, interpret=False):
+    """table[leaf_ids] for a small table — TPU replacement for the slow
+    XLA gather. leaf_ids outside [0, len(table)) yield 0.0."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    L = table.shape[0]
+    n = leaf_ids.shape[0]
+    chunk = 16384                      # [8, chunk] f32 tiles in VMEM
+    block = 8 * chunk
+    pad = (-n) % block
+    lv = jnp.pad(leaf_ids, (0, pad), constant_values=-1) \
+        .reshape(8, -1)                # row-major [8, n_pad/8]
+    tbl = table.astype(jnp.float32)[None, :]            # [1, L]
+    out = pl.pallas_call(
+        functools.partial(_leaf_gather_kernel, L=L),
+        grid=(lv.shape[1] // chunk,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((8, chunk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, chunk), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(lv.shape, jnp.float32),
+        interpret=interpret,
+    )(tbl, lv)
+    return out.reshape(-1)[:n]
+
+
+def leaf_gather(table, leaf_ids):
+    """Dispatch: Pallas sweep on TPU, plain XLA gather elsewhere."""
+    from ..utils.device import on_tpu
+    if on_tpu() and table.shape[0] <= 4096 and leaf_ids.shape[0] >= 8:
+        return leaf_gather_pallas(table, leaf_ids)
+    return table[leaf_ids]
+
+
 @jax.jit
 def add_leaf_outputs(scores, leaf_ids, leaf_output, shrinkage):
     """score += shrinkage * leaf_output[leaf] (ScoreUpdater::AddScore)."""
-    return scores + shrinkage * leaf_output[leaf_ids]
+    return scores + shrinkage * leaf_gather(leaf_output, leaf_ids)
 
 
 def predict_trees_binned(records, bins_t, meta: FeatureMeta,
@@ -58,5 +111,5 @@ def predict_trees_binned(records, bins_t, meta: FeatureMeta,
     out = jnp.zeros(n, jnp.float32)
     for rec in records:
         leaf = replay_partition(rec, bins_t, meta)
-        out = out + rec.leaf_output[leaf]
+        out = out + leaf_gather(rec.leaf_output, leaf)
     return out

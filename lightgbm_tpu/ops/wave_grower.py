@@ -147,11 +147,17 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     # fused partition+histogram path (serial mode only: the parallel
     # learners inject their own hist/partition seams)
     default_seams = (hist_fn is None and partition_fn is None)
+    quant = cfg.precision == "int8"
+    if quant and not default_seams:
+        raise ValueError("int8 quantized histograms need the default "
+                         "(serial, unbundled) seams")
     use_fused = cfg.fused
     if use_fused is None:
-        from .hist_wave import FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO
-        fused_cap = (FUSED_MAX_WAVE_HILO if cfg.precision != "default"
-                     else FUSED_MAX_WAVE)
+        from .hist_wave import (FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO,
+                                FUSED_MAX_WAVE_INT8)
+        fused_cap = (FUSED_MAX_WAVE_INT8 if quant
+                     else FUSED_MAX_WAVE_HILO
+                     if cfg.precision == "highest" else FUSED_MAX_WAVE)
         bundled = jnp.ndim(meta.bundle) != 0
         use_fused = (default_seams and W <= fused_cap
                      and not bundled and _pallas_on(cfg.use_pallas))
@@ -160,11 +166,12 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         fused_interpret = not on_tpu()
 
     if hist_fn is None:
-        def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
+        def hist_fn(bins_t, g, h, leaf_ids, wave_leaves, gh_scale=None):
             return wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
                                   num_bins=B, chunk=cfg.chunk,
                                   use_pallas=cfg.use_pallas,
-                                  precision=cfg.precision)
+                                  precision=cfg.precision,
+                                  gh_scale=gh_scale)
 
     if split_fn is None:
         def split_fn(hists, sg, sh, nd, fmask, can):
@@ -204,6 +211,32 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         hess = hess.astype(f32) * sample_mask
         in_bag = sample_mask > 0
 
+        if quant:
+            # gradient quantization (tpu_quantized_hist): integer-valued
+            # g/h in [-127, 127] make every MXU histogram product an
+            # exact int8 op at 2x the bf16 rate. Stochastic rounding
+            # keeps the per-bin sums unbiased; the PRNG key is derived
+            # from the gradients themselves so each tree re-rolls.
+            kbits = jax.lax.bitcast_convert_type(
+                jnp.sum(grad).astype(f32), jnp.int32)
+            qkey = jax.random.fold_in(jax.random.PRNGKey(1729), kbits)
+            sg_s = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-30) / 127.0
+            sh_s = jnp.maximum(jnp.max(hess), 1e-30) / 127.0
+            u = jax.random.uniform(qkey, (2, n), dtype=f32)
+            gq = jnp.clip(jnp.floor(grad / sg_s + u[0]), -127.0, 127.0)
+            hq = jnp.clip(jnp.floor(hess / sh_s + u[1]), 0.0, 127.0)
+            gh_scale = (sg_s, sh_s)
+            hg, hh = gq, hq            # what histogram passes consume
+
+            def call_hist(bt, lids, wl):
+                return hist_fn(bt, hg, hh, lids, wl, gh_scale)
+        else:
+            gh_scale = None
+            hg, hh = grad, hess
+
+            def call_hist(bt, lids, wl):
+                return hist_fn(bt, hg, hh, lids, wl)
+
         # Bagging: leaf_ids tracks ALL rows (out-of-bag rows partition
         # too — scores need their leaf), but histogram passes see
         # out-of-bag rows as leaf -1 so no wave slot counts them.
@@ -214,11 +247,17 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         root_wl = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.full(W - 1, -1, jnp.int32)])
         leaf0 = jnp.zeros(n, jnp.int32)
-        root_hist = hist_fn(bins_t, grad, hess, bag_mask_ids(leaf0),
-                            root_wl)                     # [W, F, B, 3]
+        root_hist = call_hist(bins_t, bag_mask_ids(leaf0),
+                              root_wl)                   # [W, F, B, 3]
         F_h = root_hist.shape[1]
-        root_g = reduce_fn(jnp.sum(grad))
-        root_h = reduce_fn(jnp.sum(hess))
+        if quant:
+            # root aggregates from the (dequantized) histogram itself so
+            # every later subtraction stays internally consistent
+            root_g = reduce_fn(jnp.sum(root_hist[0, 0, :, 0]))
+            root_h = reduce_fn(jnp.sum(root_hist[0, 0, :, 1]))
+        else:
+            root_g = reduce_fn(jnp.sum(grad))
+            root_h = reduce_fn(jnp.sum(hess))
         root_c = reduce_fn(jnp.sum(sample_mask))
         root_split = split_fn(
             root_hist[:1], root_g[None], root_h[None], root_c[None],
@@ -322,18 +361,18 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     meta.num_bin[safe_feat], small_ids,
                     iscat.astype(jnp.int32)]), catw.T])      # [18, W]
                 leaf_ids, hist_small = fused_partition_histogram_pallas(
-                    bins_t, grad, hess, sample_mask,
+                    bins_t, hg, hh, sample_mask,
                     state.leaf_ids, tbl, num_bins=B,
                     chunk=cfg.chunk or 8192, interpret=fused_interpret,
-                    precision=cfg.precision)
+                    precision=cfg.precision, gh_scale=gh_scale)
                 # out-of-bag rows partition too; their g/h are pre-masked
                 # and the count channel rides on sample_mask
             else:
                 leaf_ids = partition_fn(bins_t, state.leaf_ids, wl,
                                         new_ids, feat, tbin, dleft,
                                         active, iscat, catw)
-                hist_small = hist_fn(bins_t, grad, hess,
-                                     bag_mask_ids(leaf_ids), small_ids)
+                hist_small = call_hist(bins_t, bag_mask_ids(leaf_ids),
+                                       small_ids)
             parent_hist = state.hist[wl]                 # [W, F, B, 3]
             hist_large = parent_hist - hist_small
             ls4 = left_smaller[:, None, None, None]
@@ -448,8 +487,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                     iscat0, catw0)
             # left child keeps the parent id: histogram it directly,
             # sibling by subtraction (sizes don't matter here)
-            hist_left = hist_fn(bins_t, grad, hess,
-                                bag_mask_ids(leaf_ids), wl)
+            hist_left = call_hist(bins_t, bag_mask_ids(leaf_ids), wl)
             parent_hist = state.hist[wl]
             hist_right = parent_hist - hist_left
             wl_s = jnp.where(active, wl, L)
