@@ -84,17 +84,25 @@ def main():
     g.init(cfg, ds, obj, mets)
     print(f"# binning+init: {time.time()-t0:.1f}s", file=sys.stderr)
 
+    import numpy as _np
+
+    def sync():
+        # force completion with a real device->host readback:
+        # block_until_ready has been observed to return early on the
+        # tunneled backend, which would stop the clock with hundreds of
+        # iterations still queued
+        return float(_np.asarray(g._scores[0, :1])[0])
+
     # one warm-up iteration compiles the grower
     t0 = time.time()
     g.train_one_iter()
+    sync()
     print(f"# compile+iter0: {time.time()-t0:.1f}s", file=sys.stderr)
 
     t0 = time.time()
     for _ in range(args.iters - 1):
         g.train_one_iter()
-    # force completion of the async stream before stopping the clock
-    import jax
-    jax.block_until_ready(g._scores)
+    sync()
     train_s = time.time() - t0
     (_, auc, _), = g.get_eval_at(0)
     print(f"# {args.iters} iters in {train_s:.1f}s  train-AUC={auc:.5f}",

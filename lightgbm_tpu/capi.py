@@ -76,8 +76,13 @@ class _DatasetHandle:
                     .create_valid(self.X, meta)
             else:
                 ds = TpuDataset(self.cfg)
-                ds.construct_from_matrix(self.X, meta, categorical=cats)
+                ds.construct_from_matrix(
+                    self.X, meta, categorical=cats,
+                    mappers=getattr(self, "premade_mappers", None))
                 self._inner = ds
+            names = getattr(self, "feature_names", None)
+            if names:
+                self._inner.feature_names = list(names)
         return self._inner
 
 
@@ -86,6 +91,18 @@ def _parse_cat_spec(cfg: Config) -> List[int]:
     if not spec:
         return []
     return [int(x) for x in str(spec).split(",") if x.strip()]
+
+
+def _csc_to_dense(col_ptr, indices, data, num_row: int,
+                  num_col: int) -> np.ndarray:
+    X = np.zeros((int(num_row), int(num_col)), np.float64)
+    col_ptr = np.asarray(col_ptr, np.int64)
+    indices = np.asarray(indices, np.int64)
+    data = np.asarray(data, np.float64)
+    for j in range(int(num_col)):
+        sl = slice(int(col_ptr[j]), int(col_ptr[j + 1]))
+        X[indices[sl], j] = data[sl]
+    return X
 
 
 def _csr_to_dense(indptr, indices, data, num_col: int) -> np.ndarray:
@@ -403,3 +420,315 @@ def LGBM_BoosterResetParameter(handle: _BoosterHandle, parameters):
     handle.gbdt.shrinkage_rate = cfg.learning_rate
     handle.gbdt._setup_grower()
     return 0
+
+
+# ---------------------------------------------------------------------------
+# Error state (c_api.h LGBM_GetLastError / c_api.cpp:40-45)
+# ---------------------------------------------------------------------------
+
+_last_error: List[str] = ["Everything is fine"]
+
+
+def LGBM_SetLastError(msg: str):
+    _last_error[0] = str(msg)
+    return 0
+
+
+def LGBM_GetLastError() -> str:
+    return _last_error[0]
+
+
+# ---------------------------------------------------------------------------
+# Remaining Dataset entry points (c_api.cpp:150-500)
+# ---------------------------------------------------------------------------
+
+def LGBM_DatasetCreateFromCSC(col_ptr, col_ptr_type, indices, data,
+                              data_type, ncol_ptr, nelem, num_row,
+                              parameters="", reference=None
+                              ) -> _DatasetHandle:
+    """c_api.cpp:390 — column-sparse input, densified (the engine's
+    layout is dense HBM by design, io/dataset.py)."""
+    X = _csc_to_dense(col_ptr, indices, data, num_row,
+                      int(ncol_ptr) - 1)
+    return _DatasetHandle(X, _params_to_config(parameters), reference)
+
+
+def LGBM_DatasetCreateFromMats(nmat, mats, data_type, nrows, ncol,
+                               is_row_major, parameters="",
+                               reference=None) -> _DatasetHandle:
+    """c_api.cpp:330 — several stacked row blocks."""
+    blocks = [_mat_to_2d(m, nr, ncol, is_row_major)
+              for m, nr in zip(mats, nrows)]
+    return _DatasetHandle(np.vstack(blocks),
+                          _params_to_config(parameters), reference)
+
+
+def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices,
+                                        ncol, num_per_col,
+                                        num_sample_row, num_total_row,
+                                        parameters="") -> _DatasetHandle:
+    """c_api.cpp:150 — allocate an [num_total_row, ncol] dataset whose
+    bin mappers come from per-column samples; rows arrive later through
+    LGBM_DatasetPushRows."""
+    cfg = _params_to_config(parameters)
+    h = _DatasetHandle(np.zeros((int(num_total_row), int(ncol)),
+                                np.float64), cfg)
+    # sampled values only seed the bin mappers; rebuild the sample
+    # matrix with zeros elsewhere (zeros are the implied background,
+    # dataset_loader.cpp ConstructFromSampleData)
+    sm = np.zeros((int(num_sample_row), int(ncol)), np.float64)
+    for j in range(int(ncol)):
+        vals = np.asarray(sample_data[j][:num_per_col[j]], np.float64)
+        idx = np.asarray(sample_indices[j][:num_per_col[j]], np.int64)
+        sm[idx, j] = vals
+    from .io.dataset import find_column_mappers
+    h.premade_mappers = find_column_mappers(
+        sm, cfg, _parse_cat_spec(cfg), total_rows=int(num_total_row),
+        presampled=True)
+    h.num_pushed = 0
+    return h
+
+
+def LGBM_DatasetPushRows(handle: _DatasetHandle, data, data_type,
+                         nrow, ncol, start_row):
+    """c_api.cpp:230 — stream a row block into a preallocated dataset."""
+    X = _mat_to_2d(data, nrow, ncol, 1)
+    handle.X[int(start_row):int(start_row) + int(nrow)] = X
+    handle.num_pushed = max(getattr(handle, "num_pushed", 0),
+                            int(start_row) + int(nrow))
+    return 0
+
+
+def LGBM_DatasetPushRowsByCSR(handle: _DatasetHandle, indptr,
+                              indptr_type, indices, data, data_type,
+                              nindptr, nelem, num_col, start_row):
+    """c_api.cpp:260."""
+    X = _csr_to_dense(np.asarray(indptr, np.int64),
+                      np.asarray(indices, np.int64),
+                      np.asarray(data, np.float64), int(num_col))
+    handle.X[int(start_row):int(start_row) + X.shape[0]] = X
+    handle.num_pushed = max(getattr(handle, "num_pushed", 0),
+                            int(start_row) + X.shape[0])
+    return 0
+
+
+def LGBM_DatasetCreateByReference(reference: _DatasetHandle,
+                                  num_total_row) -> _DatasetHandle:
+    """c_api.cpp:215 — empty dataset binned with reference's mappers,
+    filled by PushRows."""
+    ncol = reference.X.shape[1]
+    h = _DatasetHandle(np.zeros((int(num_total_row), ncol), np.float64),
+                       reference.cfg, reference)
+    h.num_pushed = 0
+    return h
+
+
+def LGBM_DatasetGetSubset(handle: _DatasetHandle, used_row_indices,
+                          parameters="") -> _DatasetHandle:
+    """c_api.cpp:430 — Dataset::CopySubset."""
+    idx = np.asarray(used_row_indices, np.int64)
+    sub = _DatasetHandle(handle.X[idx],
+                         _params_to_config(parameters) if parameters
+                         else handle.cfg, handle.reference)
+    for k, v in handle.fields.items():
+        if v is not None and k != "group":
+            sub.fields[k] = np.asarray(v)[idx]
+    grp = handle.fields.get("group")
+    if grp is not None:
+        # ranking data: the subset must keep whole queries (the
+        # reference's CopySubset copies metadata by query); recompute
+        # sizes from the selected rows and refuse a query split
+        qb = np.concatenate([[0], np.cumsum(np.asarray(grp, np.int64))])
+        qid = np.searchsorted(qb, idx, side="right") - 1
+        take, counts = np.unique(qid, return_counts=True)
+        full = qb[take + 1] - qb[take]
+        if not np.array_equal(counts, full):
+            raise LightGBMError(
+                "DatasetGetSubset on ranking data must select whole "
+                "queries")
+        sub.fields["group"] = full
+    return sub
+
+
+def LGBM_DatasetSetFeatureNames(handle: _DatasetHandle, names):
+    handle.feature_names = [str(x) for x in names]
+    if handle._inner is not None:
+        handle._inner.feature_names = list(handle.feature_names)
+    return 0
+
+
+def LGBM_DatasetGetFeatureNames(handle: _DatasetHandle) -> List[str]:
+    if handle._inner is not None:
+        return list(handle._inner.feature_names)
+    names = getattr(handle, "feature_names", None)
+    return list(names) if names else [
+        f"Column_{i}" for i in range(handle.X.shape[1])]
+
+
+# ---------------------------------------------------------------------------
+# Remaining Booster entry points (c_api.cpp:560-1270)
+# ---------------------------------------------------------------------------
+
+def LGBM_BoosterMerge(handle: _BoosterHandle,
+                      other: _BoosterHandle):
+    """c_api.cpp:570 — append other's models."""
+    g, o = handle.gbdt, other.gbdt
+    o._ensure_host_trees()
+    g._ensure_host_trees()
+    g.records.extend(o.records)
+    g.models.extend(o.models)
+    g._tree_shrinkage.extend(o._tree_shrinkage)
+    return 0
+
+
+def LGBM_BoosterGetEvalCounts(handle: _BoosterHandle) -> int:
+    """c_api.cpp:680 — number of configured eval metrics (no
+    evaluation, no device readback)."""
+    return len(_resolve_metric_names(handle.cfg))
+
+
+def LGBM_BoosterGetFeatureNames(handle: _BoosterHandle) -> List[str]:
+    return list(handle.gbdt.feature_names)
+
+
+def LGBM_BoosterNumModelPerIteration(handle: _BoosterHandle) -> int:
+    return handle.gbdt.num_model_per_iteration()
+
+
+def LGBM_BoosterNumberOfTotalModel(handle: _BoosterHandle) -> int:
+    return len(handle.gbdt.models)
+
+
+def LGBM_BoosterGetNumPredict(handle: _BoosterHandle,
+                              data_idx: int) -> int:
+    """c_api.cpp:830 — size of the score vector for dataset data_idx."""
+    g = handle.gbdt
+    n = g._n if data_idx == 0 else g._valid_scores[data_idx - 1].shape[1]
+    return int(n) * g.num_tree_per_iteration
+
+
+def LGBM_BoosterGetPredict(handle: _BoosterHandle,
+                           data_idx: int) -> np.ndarray:
+    """c_api.cpp:840 — CONVERTED scores of train (0) / valid (1...)
+    data, flattened [K*N] like the reference's row-major copy."""
+    g = handle.gbdt
+    scores = (g._scores[:, :g._n] if data_idx == 0
+              else g._valid_scores[data_idx - 1])
+    out = np.asarray(scores, np.float64)
+    if g.objective is not None:
+        # convert on the [K, N] matrix: multiclass softmax normalizes
+        # over the CLASS axis, not the flattened vector
+        out = np.asarray(g.objective.convert_output(out))
+    return out.reshape(-1)
+
+
+def LGBM_BoosterGetLeafValue(handle: _BoosterHandle, tree_idx: int,
+                             leaf_idx: int) -> float:
+    g = handle.gbdt
+    g._ensure_host_trees()
+    return float(g.models[int(tree_idx)].leaf_value[int(leaf_idx)])
+
+
+def LGBM_BoosterSetLeafValue(handle: _BoosterHandle, tree_idx: int,
+                             leaf_idx: int, val: float):
+    """c_api.cpp:900 — Tree::SetLeafOutput on both the host tree and
+    the device record (so device prediction agrees)."""
+    import jax.numpy as jnp
+    g = handle.gbdt
+    g._ensure_host_trees()
+    g.models[int(tree_idx)].leaf_value[int(leaf_idx)] = float(val)
+    rec = g.records[int(tree_idx)]
+    g.records[int(tree_idx)] = rec._replace(
+        leaf_output=rec.leaf_output.at[int(leaf_idx)].set(
+            jnp.float32(val)))
+    g._scores_stale = True
+    return 0
+
+
+def LGBM_BoosterShuffleModels(handle: _BoosterHandle, start: int = 0,
+                              end: int = -1):
+    """c_api.cpp:590 — random permutation of a tree range (whole
+    iteration groups, matching the reference's model granularity)."""
+    g = handle.gbdt
+    g._ensure_host_trees()
+    k = max(g.num_tree_per_iteration, 1)
+    n_groups = len(g.models) // k
+    end = n_groups if end <= 0 else min(int(end), n_groups)
+    start = max(int(start), 0)
+    rng = np.random.default_rng(getattr(g.config, "data_random_seed", 1))
+    gperm = np.arange(n_groups)
+    gperm[start:end] = rng.permutation(gperm[start:end])
+    # whole iteration GROUPS move: tree t serves class t % k, so a
+    # per-tree permutation would scramble multiclass class assignment
+    perm = (gperm[:, None] * k + np.arange(k)[None, :]).reshape(-1)
+    g.models = [g.models[i] for i in perm]
+    g.records = [g.records[i] for i in perm]
+    g._tree_shrinkage = [g._tree_shrinkage[i] for i in perm]
+    return 0
+
+
+def LGBM_BoosterRefit(handle: _BoosterHandle, leaf_preds=None):
+    """c_api.cpp:600 — re-learn leaf outputs on the booster's training
+    data (GBDT::RefitTree; the leaf assignment comes from the device
+    replay, so the leaf_preds matrix of the C signature is accepted and
+    ignored)."""
+    handle.gbdt.refit_existing()
+    return 0
+
+
+def LGBM_BoosterResetTrainingData(handle: _BoosterHandle,
+                                  train_data: _DatasetHandle):
+    """c_api.cpp:580 — GBDT::ResetTrainingData: existing trees are
+    re-binned against the new data's mappers and replayed into the new
+    score vector, so training continues from the current model."""
+    g = handle.gbdt
+    inner = train_data.construct()
+    objective = g.objective
+    if objective is not None:
+        objective.init(inner.metadata, inner.num_data)
+    metrics = list(g.training_metrics)
+    if g.models:
+        g._ensure_host_trees()
+        g.init_from_loaded(handle.cfg, inner, objective, metrics)
+    else:
+        g.init(handle.cfg, inner, objective, metrics)
+    handle.train = train_data
+    return 0
+
+
+def LGBM_BoosterPredictForCSC(handle: _BoosterHandle, col_ptr,
+                              col_ptr_type, indices, data, data_type,
+                              ncol_ptr, nelem, num_row,
+                              predict_type=C_API_PREDICT_NORMAL,
+                              num_iteration=-1, parameter=""):
+    """c_api.cpp:1100 — densified column-sparse predict."""
+    X = _csc_to_dense(col_ptr, indices, data, num_row,
+                      int(ncol_ptr) - 1)
+    return _predict(handle.gbdt, X, predict_type, num_iteration)
+
+
+# ---------------------------------------------------------------------------
+# Network entry points (c_api.cpp:47-80)
+# ---------------------------------------------------------------------------
+
+def LGBM_NetworkInit(machines: str, local_listen_port: int,
+                     listen_time_out: int, num_machines: int):
+    """The reference boots its socket linkers here; the TPU engine's
+    collectives ride the JAX runtime (ICI/DCN via XLA), whose topology
+    is fixed at process start (jax.distributed.initialize) — accepted
+    and logged as the documented substitution (SURVEY §2.2)."""
+    if int(num_machines) > 1:
+        log.info("LGBM_NetworkInit: topology comes from the JAX "
+                 "runtime; machines/port arguments are not used")
+    return 0
+
+
+def LGBM_NetworkFree():
+    return 0
+
+
+def LGBM_NetworkInitWithFunctions(*_args, **_kw):
+    raise LightGBMError(
+        "custom reduce functions cannot be injected: collectives are "
+        "compiled into the XLA program (use tree_learner= to pick the "
+        "communication pattern)")

@@ -274,6 +274,9 @@ class Config:
     # (3 channels). Costs ~1e-3 AUC-grade noise on the split gains;
     # serial tree_learner without EFB bundles only.
     tpu_quantized_hist: bool = False
+    # write an xprof/tensorboard device trace of the training loop here
+    # (engine.train wraps the loop in jax.profiler.start/stop_trace)
+    tpu_profile_dir: str = ""
     # iterations between host checks for the "no more splits" stop
     # (gbdt.cpp:393-409); device→host reads are high-latency, so the stop
     # is detected periodically instead of every iteration

@@ -119,6 +119,33 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         booster.add_valid(valid_set, name)
     booster.best_iteration = 0
 
+    # xprof capture of the whole training loop (tpu_profile_dir; the
+    # reference's per-phase wall timers are utils/timing.py — this is
+    # the device-level analog, readable with tensorboard/xprof)
+    profile_dir = params.get("tpu_profile_dir", "")
+    if profile_dir:
+        import jax
+        jax.profiler.start_trace(profile_dir)
+    try:
+        evaluation_result_list = _train_loop(
+            booster, params, init_iteration, num_boost_round,
+            callbacks_before_iter, callbacks_after_iter, fobj, feval,
+            valid_sets, is_valid_contain_train)
+    finally:
+        if profile_dir:
+            import jax
+            jax.profiler.stop_trace()
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for dataset_name, eval_name, score, _ in evaluation_result_list:
+        booster.best_score[dataset_name][eval_name] = score
+    if not keep_training_booster:
+        booster.free_dataset()
+    return booster
+
+
+def _train_loop(booster, params, init_iteration, num_boost_round,
+                callbacks_before_iter, callbacks_after_iter, fobj,
+                feval, valid_sets, is_valid_contain_train):
     evaluation_result_list: List[tuple] = []
     for i in range(init_iteration, init_iteration + num_boost_round):
         for cb in callbacks_before_iter:
@@ -146,12 +173,7 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             booster.best_iteration = early_stop.best_iteration + 1
             evaluation_result_list = early_stop.best_score
             break
-    booster.best_score = collections.defaultdict(collections.OrderedDict)
-    for dataset_name, eval_name, score, _ in evaluation_result_list:
-        booster.best_score[dataset_name][eval_name] = score
-    if not keep_training_booster:
-        booster.free_dataset()
-    return booster
+    return evaluation_result_list
 
 
 class CVBooster:

@@ -218,7 +218,7 @@ class TpuDataset:
         for two_round's streaming chunks (io/loader.py)."""
         n = X.shape[0]
         f = len(self.mappers)
-        dtype = np.uint8 if self.max_bin_global <= 256 else np.int32
+        dtype = self.bin_dtype()
         bins = np.zeros((n, max(f, 1)), dtype)
         done = self._bin_matrix_native(X, bins, dtype)
         for i, real in enumerate(self.used_feature_map):
@@ -226,6 +226,18 @@ class TpuDataset:
                 continue
             bins[:, i] = self.mappers[i].value_to_bin(X[:, real]).astype(dtype)
         return bins
+
+    def bin_dtype(self):
+        """Tiered bin storage width (the reference's Dense{8,16,32}Bin,
+        src/io/dense_bin.hpp:43): uint8 up to 256 bins, uint16 to
+        65536, int32 beyond. The device tensor upcasts >8-bit tiers to
+        int32 at upload (models/gbdt.py) — the tiers size host RAM and
+        the binary cache."""
+        if self.max_bin_global <= 256:
+            return np.uint8
+        if self.max_bin_global <= 65536:
+            return np.uint16
+        return np.int32
 
     def _bin_matrix_native(self, X, bins, dtype) -> set:
         """Bulk-bin the numerical uint8 columns through the threaded C++

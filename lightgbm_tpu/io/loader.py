@@ -22,6 +22,7 @@ import numpy as np
 from ..config import Config
 from ..utils import log
 from .dataset import Metadata, TpuDataset
+from .file_io import open_file
 from .parser import parse_file
 
 
@@ -128,7 +129,7 @@ class DatasetLoader:
         """Yield data lines: header/comments/blanks skipped
         (TextReader parity, utils/text_reader.h)."""
         header_pending = self.config.header
-        with open(filename) as fh:
+        with open_file(filename) as fh:
             for ln in fh:
                 t = ln.strip()
                 if not t or t.startswith("#"):
@@ -299,7 +300,7 @@ class DatasetLoader:
         # set, label included) without parsing the whole file twice
         full_names: List[str] = []
         if cfg.header:
-            with open(filename) as fh:
+            with open_file(filename) as fh:
                 head = fh.readline()
             from .parser import detect_format
             delim = {"csv": ",", "tsv": "\t"}.get(

@@ -170,7 +170,8 @@ def _first_data_lines(filename: str, k: int, header: bool,
     head = ""
     out: List[str] = []
     header_pending = header
-    with open(filename, "r") as fh:
+    from .file_io import open_file
+    with open_file(filename, "r") as fh:
         for ln in fh:
             t = ln.strip()
             if not t or (ignore_comments and t.startswith("#")):
@@ -222,7 +223,8 @@ def parse_file(filename: str, header: bool = False, label_idx: int = 0,
                                               - values.shape[1])))
         parsed = ParsedText(values, labels)
     else:
-        with open(filename, "r") as fh:
+        from .file_io import open_file
+        with open_file(filename, "r") as fh:
             raw = fh.read().splitlines()
         lines = [ln for ln in raw if ln.strip()
                  and not (ignore_comments

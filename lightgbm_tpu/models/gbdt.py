@@ -108,6 +108,10 @@ class GBDT:
         host_bins = (train_data.bundled_bins if self._use_bundles
                      else train_data.bins)
         bins_t = np.ascontiguousarray(host_bins.T)
+        if bins_t.dtype == np.uint16:
+            # device kernels take uint8 or int32; the uint16 tier only
+            # sizes host storage (io/dataset.py bin_dtype)
+            bins_t = bins_t.astype(np.int32)
         if self._pad_rows:
             bins_t = np.pad(bins_t, ((0, 0), (0, self._pad_rows)))
         if self._pad_features:

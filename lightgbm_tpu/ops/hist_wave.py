@@ -361,7 +361,7 @@ FUSED_MAX_WAVE_INT8 = 42     # 3 channels (int8 gq/hq/count)
 
 def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
                   hist_ref, leaf_out_ref, *, F, B, W, groups, group_sz,
-                  hilo, exact_dot=False, int8=False):
+                  hilo, exact_dot=False, int8=False, any_cat=True):
     """One grid step: partition one row chunk by the wave's W splits,
     then accumulate the wave's smaller-child histograms — ONE data pass.
 
@@ -400,7 +400,6 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     ct = leaf.shape[1]
 
     # per-slot split parameters as [W, 1] columns
-    feat_c = tbl_ref[:W, TBL_FEAT:TBL_FEAT + 1]
     bin_c = tbl_ref[:W, TBL_BIN:TBL_BIN + 1]
     dleft_c = tbl_ref[:W, TBL_DLEFT:TBL_DLEFT + 1]
     miss_c = tbl_ref[:W, TBL_MISS:TBL_MISS + 1]
@@ -412,12 +411,30 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     iscat_c = tbl_ref[:W, TBL_ISCAT:TBL_ISCAT + 1]
 
     # ---- partition (DataPartition::Split, data_partition.hpp:109) ----
-    # cols[k, :] = bins of slot k's split feature: select among the
-    # feature ROWS (lane vectors) — no column extraction, no relayout
-    cols = jnp.zeros((W, ct), i32)
-    for f in range(F):
-        cols = jnp.where(feat_c == f,
-                         binsf_ref[f, :].astype(i32)[None, :], cols)
+    # cols[k, :] = bins of slot k's split feature, fetched as ONE MXU
+    # row-gather: a [W, F] one-hot over features times the bf16 bins
+    # tile. Bin values <= 255 are exactly bf16-representable and each
+    # output has a single nonzero product, so the gather is exact —
+    # and it replaces the previous F-deep select sweep over [W, Ct]
+    # (F x W VPU ops per row) with an F-contraction matmul.
+    feat_c = tbl_ref[:W, TBL_FEAT:TBL_FEAT + 1]
+    if B <= 256:
+        f_iota = jax.lax.broadcasted_iota(i32, (W, F), 1)
+        feat_oh = (f_iota == feat_c).astype(jnp.bfloat16)   # [W, F]
+        # (Mosaic has no u8->bf16 cast; hop through i32)
+        bins_bf = binsf_ref[...].astype(i32) \
+            .astype(jnp.bfloat16)                           # [F, Ct]
+        cols = jax.lax.dot_general(
+            feat_oh, bins_bf,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(i32)  # [W, Ct]
+    else:
+        # bins above 256 are not exactly bf16-representable: keep the
+        # exact F-deep select sweep for the wide-bin tier
+        cols = jnp.zeros((W, ct), i32)
+        for f in range(F):
+            cols = jnp.where(feat_c == f,
+                             binsf_ref[f, :].astype(i32)[None, :], cols)
     # missing semantics match ops/partition.py row_goes_right; logical
     # form, not jnp.where-on-bools (Mosaic can't lower the i8->i1
     # truncation a boolean select produces)
@@ -426,18 +443,22 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     right = ((is_missing & (dleft_c == 0))
              | (~is_missing & (cols > bin_c)))
     # categorical: the bin's bit set in the slot's left bitset -> LEFT
-    # (dense_bin.hpp SplitCategorical); unseen/NaN bins go right
-    widx = jnp.right_shift(cols, 5)
-    word = jnp.zeros_like(cols)
-    for wq in range(8):
-        word = jnp.where(widx == wq,
-                         tbl_ref[:W, TBL_CATW + wq:TBL_CATW + wq + 1],
-                         word)
-    cat_left = jnp.bitwise_and(
-        jnp.right_shift(word, jnp.bitwise_and(cols, 31)), 1) != 0
-    # logical form (no bool select — see `right` above)
-    iscat_b = iscat_c > 0
-    right = (iscat_b & ~cat_left) | (~iscat_b & right)
+    # (dense_bin.hpp SplitCategorical); unseen/NaN bins go right.
+    # Statically skipped when the dataset has no categorical features
+    # (any_cat) — the 8-way word select + bit test is ~400 VPU ops/row.
+    if any_cat:
+        widx = jnp.right_shift(cols, 5)
+        word = jnp.zeros_like(cols)
+        for wq in range(8):
+            word = jnp.where(
+                widx == wq,
+                tbl_ref[:W, TBL_CATW + wq:TBL_CATW + wq + 1],
+                word)
+        cat_left = jnp.bitwise_and(
+            jnp.right_shift(word, jnp.bitwise_and(cols, 31)), 1) != 0
+        # logical form (no bool select — see `right` above)
+        iscat_b = iscat_c > 0
+        right = (iscat_b & ~cat_left) | (~iscat_b & right)
     moved = (leaf == parent_c) & right & (parent_c >= 0)    # [W, Ct]
     any_moved = jnp.any(moved, axis=0, keepdims=True)       # [1, Ct]
     dest = jnp.sum(jnp.where(moved, new_c, 0), axis=0,
@@ -510,12 +531,13 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk",
-                                             "interpret", "precision"))
+                                             "interpret", "precision",
+                                             "any_cat"))
 def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
                                      leaf_ids, tbl, *, num_bins,
                                      chunk=2048, interpret=False,
                                      precision="highest",
-                                     gh_scale=None):
+                                     gh_scale=None, any_cat=True):
     """Partition one wave + build its smaller-child histograms in ONE
     data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]).
 
@@ -569,7 +591,8 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
 
     kernel = functools.partial(
         _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz,
-        hilo=hilo, exact_dot=interpret and not int8, int8=int8)
+        hilo=hilo, exact_dot=interpret and not int8, int8=int8,
+        any_cat=any_cat)
 
     hist, leaf_out = pl.pallas_call(
         kernel,

@@ -364,7 +364,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     bins_t, hg, hh, sample_mask,
                     state.leaf_ids, tbl, num_bins=B,
                     chunk=cfg.chunk or 8192, interpret=fused_interpret,
-                    precision=cfg.precision, gh_scale=gh_scale)
+                    precision=cfg.precision, gh_scale=gh_scale,
+                    any_cat=bool(hp.has_cat))
                 # out-of-bag rows partition too; their g/h are pre-masked
                 # and the count channel rides on sample_mask
             else:

@@ -100,3 +100,122 @@ class TestLrbDriver:
         assert 0 <= r2["fp_rate"] <= 1 and 0 <= r2["fn_rate"] <= 1
         # the learned admission policy beats chance: error rates bounded
         assert r3["fp_rate"] + r3["fn_rate"] < 0.9
+
+
+class TestCApiExtended:
+    """The remaining c_api.h surface (59-function parity)."""
+
+    def _csc(self, X):
+        col_ptr = [0]
+        indices, data = [], []
+        for j in range(X.shape[1]):
+            nz = np.nonzero(X[:, j])[0]
+            indices.extend(nz.tolist())
+            data.extend(X[nz, j].tolist())
+            col_ptr.append(len(indices))
+        return col_ptr, indices, data
+
+    def test_csc_create_and_predict(self):
+        X, y = _data()
+        params = "objective=binary num_leaves=15 min_data_in_leaf=5"
+        col_ptr, indices, data = self._csc(X)
+        ds = capi.LGBM_DatasetCreateFromCSC(
+            col_ptr, 3, indices, data, 1, len(col_ptr), len(data),
+            X.shape[0], parameters=params)
+        np.testing.assert_allclose(ds.X, X)
+        capi.LGBM_DatasetSetField(ds, "label", y)
+        bst = capi.LGBM_BoosterCreate(ds, params)
+        for _ in range(8):
+            capi.LGBM_BoosterUpdateOneIter(bst)
+        p_csc = capi.LGBM_BoosterPredictForCSC(
+            bst, col_ptr, 3, indices, data, 1, len(col_ptr), len(data),
+            X.shape[0])
+        p_mat = capi.LGBM_BoosterPredictForMat(bst, X)
+        np.testing.assert_allclose(p_csc, p_mat, atol=1e-6)
+
+    def test_mats_subset_names_counts(self):
+        X, y = _data(n=400)
+        params = ("objective=binary num_leaves=15 min_data_in_leaf=5 "
+                  "metric=auc is_provide_training_metric=true")
+        ds = capi.LGBM_DatasetCreateFromMats(
+            2, [X[:150], X[150:]], 1, [150, 250], X.shape[1], 1,
+            parameters=params)
+        capi.LGBM_DatasetSetField(ds, "label", y)
+        capi.LGBM_DatasetSetFeatureNames(
+            ds, [f"f{i}" for i in range(X.shape[1])])
+        assert capi.LGBM_DatasetGetFeatureNames(ds)[0] == "f0"
+        sub = capi.LGBM_DatasetGetSubset(ds, np.arange(0, 400, 2))
+        assert sub.X.shape == (200, X.shape[1])
+        assert len(sub.fields["label"]) == 200
+
+        bst = capi.LGBM_BoosterCreate(ds, params)
+        for _ in range(6):
+            capi.LGBM_BoosterUpdateOneIter(bst)
+        assert capi.LGBM_BoosterGetEvalCounts(bst) >= 1
+        assert capi.LGBM_BoosterNumModelPerIteration(bst) == 1
+        assert capi.LGBM_BoosterNumberOfTotalModel(bst) == 6
+        assert capi.LGBM_BoosterGetFeatureNames(bst)[0] == "f0"
+        n_pred = capi.LGBM_BoosterGetNumPredict(bst, 0)
+        assert n_pred == 400
+        raw = capi.LGBM_BoosterGetPredict(bst, 0)
+        assert raw.shape == (400,)
+        p = capi.LGBM_BoosterPredictForMat(bst, X)
+        np.testing.assert_allclose(raw, np.asarray(p).reshape(-1),
+                                   atol=1e-5)
+
+    def test_leaf_value_roundtrip_and_merge(self):
+        X, y = _data()
+        params = "objective=binary num_leaves=15 min_data_in_leaf=5"
+        ds = capi.LGBM_DatasetCreateFromMat(X, parameters=params)
+        capi.LGBM_DatasetSetField(ds, "label", y)
+        bst = capi.LGBM_BoosterCreate(ds, params)
+        for _ in range(4):
+            capi.LGBM_BoosterUpdateOneIter(bst)
+        v = capi.LGBM_BoosterGetLeafValue(bst, 0, 1)
+        capi.LGBM_BoosterSetLeafValue(bst, 0, 1, v + 1.0)
+        assert capi.LGBM_BoosterGetLeafValue(bst, 0, 1) ==             pytest.approx(v + 1.0)
+
+        ds2 = capi.LGBM_DatasetCreateFromMat(X, parameters=params)
+        capi.LGBM_DatasetSetField(ds2, "label", y)
+        bst2 = capi.LGBM_BoosterCreate(ds2, params)
+        for _ in range(3):
+            capi.LGBM_BoosterUpdateOneIter(bst2)
+        capi.LGBM_BoosterMerge(bst, bst2)
+        assert capi.LGBM_BoosterNumberOfTotalModel(bst) == 7
+
+    def test_sampled_column_push_rows(self):
+        X, y = _data(n=200)
+        params = "objective=binary num_leaves=15 min_data_in_leaf=5"
+        cols = [X[:, j] for j in range(X.shape[1])]
+        idx = [np.arange(200)] * X.shape[1]
+        ds = capi.LGBM_DatasetCreateFromSampledColumn(
+            cols, idx, X.shape[1], [200] * X.shape[1], 200, 200,
+            parameters=params)
+        capi.LGBM_DatasetPushRows(ds, X[:120], 1, 120, X.shape[1], 0)
+        capi.LGBM_DatasetPushRows(ds, X[120:], 1, 80, X.shape[1], 120)
+        capi.LGBM_DatasetSetField(ds, "label", y)
+        bst = capi.LGBM_BoosterCreate(ds, params)
+        for _ in range(8):
+            capi.LGBM_BoosterUpdateOneIter(bst)
+        p = capi.LGBM_BoosterPredictForMat(bst, X)
+        assert ((np.asarray(p) > 0.5) == y).mean() > 0.85
+
+    def test_network_and_error_state(self):
+        assert capi.LGBM_NetworkInit("127.0.0.1:12400", 12400, 120, 1) == 0
+        assert capi.LGBM_NetworkFree() == 0
+        with pytest.raises(Exception):
+            capi.LGBM_NetworkInitWithFunctions(1, 2)
+        capi.LGBM_SetLastError("boom")
+        assert capi.LGBM_GetLastError() == "boom"
+
+    def test_refit_and_reset_training_data(self):
+        X, y = _data(n=300)
+        params = "objective=binary num_leaves=15 min_data_in_leaf=5"
+        ds = capi.LGBM_DatasetCreateFromMat(X, parameters=params)
+        capi.LGBM_DatasetSetField(ds, "label", y)
+        bst = capi.LGBM_BoosterCreate(ds, params)
+        for _ in range(5):
+            capi.LGBM_BoosterUpdateOneIter(bst)
+        assert capi.LGBM_BoosterRefit(bst) == 0
+        p = capi.LGBM_BoosterPredictForMat(bst, X)
+        assert ((np.asarray(p) > 0.5) == y).mean() > 0.85

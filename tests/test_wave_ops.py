@@ -338,3 +338,47 @@ class TestInt8Histogram:
         np.testing.assert_allclose(
             np.sort(np.asarray(quant.leaf_output)[:12]),
             np.sort(np.asarray(exact.leaf_output)[:12]), atol=0.05)
+
+
+class TestWideBins:
+    def test_fused_wide_bin_tier_exact(self):
+        """>256 bins: the partition must stay exact (the bf16 MXU
+        row-gather only covers the uint8 tier)."""
+        from lightgbm_tpu.ops.hist_wave import (
+            fused_partition_histogram_pallas)
+        from lightgbm_tpu.ops.wave_grower import apply_wave_splits
+        r = np.random.default_rng(31)
+        N, F, B, W = 700, 4, 320, 8
+        bins_t = r.integers(0, B, (F, N)).astype(np.int32)
+        g = r.normal(size=N).astype(np.float32)
+        h = r.uniform(0.1, 1, N).astype(np.float32)
+        mask = np.ones(N, np.float32)
+        leaf = r.integers(0, 4, N).astype(np.int32)
+        meta_np = FeatureMeta(
+            num_bin=np.full(F, B, np.int32),
+            missing_type=np.zeros(F, np.int32),
+            default_bin=np.zeros(F, np.int32),
+            monotone=np.zeros(F, np.int32),
+            penalty=np.ones(F, np.float32))
+        meta = FeatureMeta(*[jnp.asarray(x) for x in meta_np])
+        wl = np.array([0, 1, 2, 3, -1, -1, -1, -1], np.int32)
+        new_ids = np.array([4, 5, 6, 7, -1, -1, -1, -1], np.int32)
+        feat = r.integers(0, F, W).astype(np.int32)
+        # thresholds far above 256 exercise the wide tier
+        tbin = r.integers(250, 310, W).astype(np.int32)
+        dleft = np.zeros(W, bool)
+        tbl = jnp.stack([jnp.asarray(x) for x in [
+            wl, new_ids, feat, tbin, dleft.astype(np.int32),
+            meta_np.missing_type[feat], meta_np.default_bin[feat],
+            meta_np.num_bin[feat], new_ids,
+            np.zeros(W, np.int32)]])
+        leaf_f, _ = fused_partition_histogram_pallas(
+            jnp.asarray(bins_t), jnp.asarray(g), jnp.asarray(h),
+            jnp.asarray(mask), jnp.asarray(leaf), tbl,
+            num_bins=B, chunk=256, interpret=True)
+        leaf_u = apply_wave_splits(
+            jnp.asarray(bins_t), jnp.asarray(leaf), jnp.asarray(wl),
+            jnp.asarray(new_ids), jnp.asarray(feat), jnp.asarray(tbin),
+            jnp.asarray(dleft), jnp.asarray(wl >= 0), meta)
+        np.testing.assert_array_equal(np.asarray(leaf_f),
+                                      np.asarray(leaf_u))
