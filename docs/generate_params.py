@@ -1,0 +1,73 @@
+"""Generate docs/Parameters.md from the Config dataclass.
+
+Counterpart of the reference's parameter-docs generator
+(reference: helpers/parameter_generator.py producing docs/Parameters.rst
+from config.h comments): here the single source of truth is
+``lightgbm_tpu/config.py`` — dataclass fields, their defaults, the
+alias table, and the documented-substitution lists all come from the
+live object, so the page can never drift from the code.
+
+Run: ``python docs/generate_params.py`` (writes docs/Parameters.md).
+"""
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from lightgbm_tpu.config import ALIAS_TABLE, Config  # noqa: E402
+
+
+def main() -> None:
+    cfg = Config()
+    inv_alias = {}
+    for alias, canon in ALIAS_TABLE.items():
+        inv_alias.setdefault(canon, []).append(alias)
+
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` by "
+        "`docs/generate_params.py` — do not edit by hand.",
+        "",
+        "Every parameter accepts the reference's aliases; names and "
+        "defaults match the reference's `docs/Parameters.rst` except "
+        "for the `tpu_*` additions (TPU execution knobs) and the "
+        "documented substitutions listed at the end.",
+        "",
+        "| parameter | default | aliases |",
+        "|---|---|---|",
+    ]
+    for f in dataclasses.fields(Config):
+        if f.name.startswith("_"):
+            continue
+        default = getattr(cfg, f.name)
+        aliases = ", ".join(sorted(inv_alias.get(f.name, []))) or "—"
+        shown = repr(default) if default != "" else '""'
+        lines.append(f"| `{f.name}` | `{shown}` | {aliases} |")
+
+    lines += [
+        "",
+        "## Accepted-but-substituted parameters",
+        "",
+        "These reference parameters are accepted for compatibility; "
+        "their role is played by the TPU design instead:",
+        "",
+    ]
+    for key, why in Config._SUBSUMED.items():
+        lines.append(f"- `{key}` — {why}")
+    lines += [
+        "",
+        "## Accepted-but-unimplemented parameters",
+        "",
+    ]
+    for key in Config._UNIMPLEMENTED:
+        lines.append(f"- `{key}` — accepted, warns, has no effect yet")
+    out = os.path.join(os.path.dirname(__file__), "Parameters.md")
+    with open(out, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    print(f"wrote {out}: {len(lines)} lines")
+
+
+if __name__ == "__main__":
+    main()

@@ -356,10 +356,59 @@ class TpuDataset:
 
     # -- binary cache (SaveBinaryFile parity, dataset.cpp:542) --------------
 
-    BINARY_TOKEN = b"______LightGBM_TPU_Binary_File_Token______\n"
+    # v2 writes nibble-packed dict bins; the version lives in the token
+    # so a pre-v2 reader REJECTS new files instead of loading a dict it
+    # cannot use. v1 files (plain array bins) are still readable.
+    BINARY_TOKEN = b"______LightGBM_TPU_Binary_File_Tokenv2____\n"
+    BINARY_TOKEN_V1 = b"______LightGBM_TPU_Binary_File_Token______\n"
+
+    def _pack_nibble_columns(self):
+        """4-bit storage tier (the reference's Dense4bitsBin,
+        src/io/dense_nbits_bin.hpp:37-58): columns with <= 16 bins are
+        nibble-packed two-rows-per-byte in the binary cache. (No
+        compute-path tier is needed here: 16-bin features already pack
+        8 per 128-row MXU tile in the wave kernel, so packing would
+        only inflate the matmul.) Returns (bins_or_packed, packed_cols).
+        """
+        if self.bins is None or self.bins.dtype != np.uint8 \
+                or not self.mappers:
+            return self.bins, []
+        packed_cols = [i for i, m in enumerate(self.mappers)
+                       if m.num_bin <= 16]
+        if not packed_cols:
+            return self.bins, []
+        out = {"shape": self.bins.shape}
+        n = self.bins.shape[0]
+        half = (n + 1) // 2
+        for i in packed_cols:
+            col = self.bins[:, i]
+            lo = col[0::2]
+            hi = np.zeros(half, np.uint8)
+            hi[:n // 2] = col[1::2]
+            out[i] = (lo | (hi << 4)).astype(np.uint8)
+        packed_set = set(packed_cols)
+        keep = [i for i in range(self.bins.shape[1])
+                if i not in packed_set]
+        out["rest"] = self.bins[:, keep]
+        out["keep"] = keep
+        return out, packed_cols
+
+    @staticmethod
+    def _unpack_nibble_columns(bins, packed_cols):
+        if not packed_cols:
+            return bins
+        n, f = bins["shape"]
+        full = np.zeros((n, f), np.uint8)
+        full[:, bins["keep"]] = bins["rest"]
+        for i in packed_cols:
+            b = bins[i]
+            full[0::2, i] = b[: (n + 1) // 2] & 0x0F
+            full[1::2, i] = (b[: n // 2] >> 4) & 0x0F
+        return full
 
     def save_binary(self, filename: str) -> None:
         import pickle
+        bins_repr, packed_cols = self._pack_nibble_columns()
         with open(filename, "wb") as fh:
             fh.write(self.BINARY_TOKEN)
             pickle.dump({
@@ -367,7 +416,8 @@ class TpuDataset:
                 "num_total_features": self.num_total_features,
                 "mappers": [m.to_dict() for m in self.mappers],
                 "used_feature_map": self.used_feature_map,
-                "bins": self.bins,
+                "bins": bins_repr,
+                "packed_cols": packed_cols,
                 "label": self.metadata.label,
                 "weights": self.metadata.weights,
                 "query_boundaries": self.metadata.query_boundaries,
@@ -380,7 +430,8 @@ class TpuDataset:
     def is_binary_file(cls, filename: str) -> bool:
         try:
             with open(filename, "rb") as fh:
-                return fh.read(len(cls.BINARY_TOKEN)) == cls.BINARY_TOKEN
+                tok = fh.read(len(cls.BINARY_TOKEN))
+                return tok in (cls.BINARY_TOKEN, cls.BINARY_TOKEN_V1)
         except OSError:
             return False
 
@@ -389,7 +440,7 @@ class TpuDataset:
         import pickle
         with open(filename, "rb") as fh:
             tok = fh.read(len(cls.BINARY_TOKEN))
-            if tok != cls.BINARY_TOKEN:
+            if tok not in (cls.BINARY_TOKEN, cls.BINARY_TOKEN_V1):
                 log.fatal(f"{filename} is not a lightgbm_tpu binary file")
             d = pickle.load(fh)
         ds = cls(config)
@@ -398,7 +449,8 @@ class TpuDataset:
         ds.mappers = [BinMapper.from_dict(m) for m in d["mappers"]]
         ds.used_feature_map = d["used_feature_map"]
         ds.real_to_inner = {r: i for i, r in enumerate(ds.used_feature_map)}
-        ds.bins = d["bins"]
+        ds.bins = cls._unpack_nibble_columns(
+            d["bins"], d.get("packed_cols", []))
         ds.metadata = Metadata(d["label"], d["weights"], None, d["init_score"])
         ds.metadata.query_boundaries = d["query_boundaries"]
         ds.feature_names = d["feature_names"]

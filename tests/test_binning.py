@@ -129,3 +129,31 @@ class TestDataset:
         ds = TpuDataset(cfg).construct_from_matrix(
             X, Metadata(label=r.normal(size=2000)))
         assert all(m.num_bin <= 15 for m in ds.mappers)
+
+
+class TestNibblePackedCache:
+    def test_binary_cache_roundtrip_with_4bit_columns(self, tmp_path):
+        """Columns with <= 16 bins nibble-pack in the binary cache
+        (Dense4bitsBin storage tier) and round-trip bit-exactly."""
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+
+        r = np.random.default_rng(7)
+        n = 1001                           # odd: exercises the tail row
+        X = np.column_stack([
+            r.integers(0, 3, n),           # few bins -> packed
+            r.normal(size=n),              # many bins -> unpacked
+            r.integers(0, 5, n),           # packed
+        ]).astype(np.float64)
+        cfg = Config().set({"objective": "binary", "max_bin": 63,
+                            "min_data_in_leaf": 1, "min_data_in_bin": 1})
+        ds = TpuDataset(cfg).construct_from_matrix(
+            X, Metadata(label=r.uniform(size=n).astype(np.float32)))
+        packed_repr, packed_cols = ds._pack_nibble_columns()
+        assert len(packed_cols) == 2       # the two low-cardinality cols
+        f = tmp_path / "c.bin"
+        ds.save_binary(str(f))
+        loaded = TpuDataset.load_binary(str(f), cfg)
+        np.testing.assert_array_equal(loaded.bins, ds.bins)
+        np.testing.assert_array_equal(loaded.metadata.label,
+                                      ds.metadata.label)
