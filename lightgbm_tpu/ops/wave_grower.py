@@ -120,20 +120,26 @@ def _store_batch(table, idx, vals, active):
 
 def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                      hist_fn=None, split_fn=None, partition_fn=None,
-                     reduce_fn=None, jit=True):
+                     reduce_fn=None, hist_reduce_fn=None, jit=True):
     """Build ``grow(bins_t, grad, hess, sample_mask, feature_mask)``.
 
     bins_t is FEATURE-MAJOR [F, N] (see ops/hist_wave.py).
 
     Injection seams for the parallel learners (SURVEY §2.2):
       hist_fn(bins_t, g, h, leaf_ids, wave_leaves) -> [W, F_hist, B, 3]
-        (data-parallel: local wave hist + psum; feature-parallel: local
-        feature slice; voting: local hist, election in split_fn)
+        (feature-parallel: local feature slice; voting: local hist,
+        election in split_fn)
       split_fn(hists [M,F,B,3], sg [M], sh [M], nd [M], fmask, can [M])
         -> SplitResult of [M] arrays with GLOBAL feature indices
       partition_fn(bins_t, leaf_ids, wl, new_ids, feat, tbin, dleft,
                    active) -> new leaf_ids  (local rows)
       reduce_fn(x) -> global sum of a locally-summed scalar
+      hist_reduce_fn(hist) -> cross-device sum of a wave histogram
+        (data-parallel psum). Unlike hist_fn, this seam COMPOSES with
+        the fused partition+histogram kernel: each shard partitions and
+        histograms its own rows in one Pallas pass and only the [W, F,
+        B, 3] result rides the collective — the multi-chip path keeps
+        the single-chip kernel.
 
     All default to serial single-device implementations. ``jit=False``
     returns the raw traceable fn for wrapping in shard_map.
@@ -191,6 +197,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         def reduce_fn(x):
             return x
 
+    if hist_reduce_fn is None:
+        def hist_reduce_fn(h):
+            return h
+
     def depth_ok(depth):
         if cfg.max_depth > 0:
             return depth < cfg.max_depth
@@ -247,8 +257,9 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         root_wl = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.full(W - 1, -1, jnp.int32)])
         leaf0 = jnp.zeros(n, jnp.int32)
-        root_hist = call_hist(bins_t, bag_mask_ids(leaf0),
-                              root_wl)                   # [W, F, B, 3]
+        root_hist = hist_reduce_fn(
+            call_hist(bins_t, bag_mask_ids(leaf0),
+                      root_wl))                          # [W, F, B, 3]
         F_h = root_hist.shape[1]
         if quant:
             # root aggregates from the (dequantized) histogram itself so
@@ -366,14 +377,16 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     chunk=cfg.chunk or 8192, interpret=fused_interpret,
                     precision=cfg.precision, gh_scale=gh_scale,
                     any_cat=bool(hp.has_cat))
+                hist_small = hist_reduce_fn(hist_small)
                 # out-of-bag rows partition too; their g/h are pre-masked
                 # and the count channel rides on sample_mask
             else:
                 leaf_ids = partition_fn(bins_t, state.leaf_ids, wl,
                                         new_ids, feat, tbin, dleft,
                                         active, iscat, catw)
-                hist_small = call_hist(bins_t, bag_mask_ids(leaf_ids),
-                                       small_ids)
+                hist_small = hist_reduce_fn(
+                    call_hist(bins_t, bag_mask_ids(leaf_ids),
+                              small_ids))
             parent_hist = state.hist[wl]                 # [W, F, B, 3]
             hist_large = parent_hist - hist_small
             ls4 = left_smaller[:, None, None, None]
@@ -488,7 +501,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                     iscat0, catw0)
             # left child keeps the parent id: histogram it directly,
             # sibling by subtraction (sizes don't matter here)
-            hist_left = call_hist(bins_t, bag_mask_ids(leaf_ids), wl)
+            hist_left = hist_reduce_fn(
+                call_hist(bins_t, bag_mask_ids(leaf_ids), wl))
             parent_hist = state.hist[wl]
             hist_right = parent_hist - hist_left
             wl_s = jnp.where(active, wl, L)

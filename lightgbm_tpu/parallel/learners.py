@@ -96,17 +96,17 @@ def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     (DataParallelTreeLearner semantics; the reference reduce-scatters so
     each worker reduces a feature subset — with XLA the psum IS the
     reduce+broadcast and the compiler picks the wire algorithm.)
+
+    The collective rides the ``hist_reduce_fn`` seam, NOT a hist_fn
+    override, so the grower keeps its default seams and the FUSED
+    partition+histogram Pallas kernel stays live per shard — on a real
+    mesh each chip runs the same single-chip kernel on its rows and
+    only the [W, F, B, 3] histograms cross ICI.
     """
-    local_hist = _hist(cfg)
-
-    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
-        return jax.lax.psum(
-            local_hist(bins_t, g, h, leaf_ids, wave_leaves), AXIS)
-
     def reduce_fn(x):
         return jax.lax.psum(x, AXIS)
 
-    grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
+    grow = make_wave_grower(cfg, meta, hist_reduce_fn=reduce_fn,
                             reduce_fn=reduce_fn, jit=False)
     sharded = jax.shard_map(
         grow, mesh=mesh,
@@ -170,9 +170,10 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         min_data_in_leaf=cfg.hp.min_data_in_leaf / D,
         min_sum_hessian_in_leaf=cfg.hp.min_sum_hessian_in_leaf / D)
 
-    # LOCAL histograms — no psum; the election decides what is summed
-    hist_fn = _hist(cfg)
-
+    # LOCAL histograms — no psum; the election decides what is summed.
+    # No hist_fn override: the default seams keep the fused
+    # partition+histogram kernel live per shard (its output is exactly
+    # the local wave histogram the election wants).
     def reduce_fn(x):
         return jax.lax.psum(x, AXIS)
 
@@ -233,7 +234,7 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     axis=1)[:, 0],
                 -1))
 
-    grow = make_wave_grower(cfg, meta, hist_fn=hist_fn, split_fn=split_fn,
+    grow = make_wave_grower(cfg, meta, split_fn=split_fn,
                             reduce_fn=reduce_fn, jit=False)
     sharded = jax.shard_map(
         grow, mesh=mesh,

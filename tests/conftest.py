@@ -96,3 +96,15 @@ def make_multiclass(n=1280, f=10, k=4, seed=2):
     d = ((X[:, None, :] - centers[None]) ** 2).sum(-1)
     y = np.argmin(d + 0.5 * r.normal(size=(n, k)), axis=1).astype(np.float32)
     return X, y
+
+
+def rank_auc(y, scores):
+    """Hand-rolled Mann-Whitney AUC (no sklearn in the image)."""
+    import numpy as np
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores), dtype=np.float64)
+    ranks[order] = np.arange(len(scores))
+    pos = np.asarray(y) > 0.5
+    npos, nneg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - npos * (npos - 1) / 2) / max(
+        npos * nneg, 1)

@@ -446,14 +446,7 @@ def test_quantized_hist_training_quality():
                          "num_leaves": 15, "max_bin": 63,
                          "min_data_in_leaf": 5, "verbose": -1,
                          "tpu_quantized_hist": quant}, ds, 30)
-        p = bst.predict(X)
-        # hand-rolled AUC to avoid a sklearn dependency
-        order = np.argsort(p)
-        ranks = np.empty_like(order, dtype=np.float64)
-        ranks[order] = np.arange(len(p))
-        pos = y > 0.5
-        auc = (ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2) / (
-            pos.sum() * (~pos).sum())
-        out[quant] = auc
+        from conftest import rank_auc
+        out[quant] = rank_auc(y, bst.predict(X))
     assert out[True] == pytest.approx(out[False], abs=0.01)
     assert out[True] > 0.97
