@@ -1,0 +1,98 @@
+"""Generate the example datasets (the reference ships committed data
+files under examples/*; this repo generates equivalent synthetic ones
+so the examples are self-contained and the repo stays small).
+
+Run once before using any example config:
+    python examples/generate_data.py
+"""
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _save(subdir, name, y, X, fmt="%.6g"):
+    path = os.path.join(HERE, subdir, name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt=fmt)
+    return path
+
+
+def binary(rng):
+    def make(n, seed_shift=0):
+        X = rng.normal(size=(n, 28))
+        logit = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2] - 0.3 * X[:, 3]
+                 + 0.2 * np.abs(X[:, 4]))
+        y = (logit + 0.4 * rng.normal(size=n) > 0).astype(int)
+        return y, X
+    _save("binary_classification", "binary.train", *make(7000))
+    _save("binary_classification", "binary.test", *make(500))
+
+
+def regression(rng):
+    def make(n):
+        X = rng.normal(size=(n, 10))
+        y = (X[:, 0] + 0.6 * X[:, 1] * X[:, 2] - 0.4 * X[:, 3] ** 2
+             + 0.2 * rng.normal(size=n))
+        return y, X
+    _save("regression", "regression.train", *make(7000))
+    _save("regression", "regression.test", *make(500))
+
+
+def multiclass(rng):
+    def make(n):
+        X = rng.normal(size=(n, 12))
+        score = np.stack([X[:, 0] + X[:, 1], X[:, 2] - X[:, 3],
+                          X[:, 4] * X[:, 5], -X[:, 0] + X[:, 6],
+                          0.5 * X[:, 7]], axis=1)
+        y = np.argmax(score + 0.3 * rng.normal(size=score.shape), axis=1)
+        return y, X
+    _save("multiclass_classification", "multiclass.train", *make(7000))
+    _save("multiclass_classification", "multiclass.test", *make(500))
+
+
+def lambdarank(rng):
+    def make(n_query, rows_per_q):
+        n = n_query * rows_per_q
+        X = rng.normal(size=(n, 8))
+        rel = X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.normal(size=n)
+        # graded relevance 0-4 per query
+        y = np.zeros(n, int)
+        for q in range(n_query):
+            s = slice(q * rows_per_q, (q + 1) * rows_per_q)
+            y[s] = np.clip(np.digitize(
+                rel[s], np.quantile(rel[s], [0.5, 0.75, 0.9, 0.97])),
+                0, 4)
+        return y, X, np.full(n_query, rows_per_q, int)
+    y, X, q = make(350, 20)
+    _save("lambdarank", "rank.train", y, X)
+    np.savetxt(os.path.join(HERE, "lambdarank", "rank.train.query"),
+               q, fmt="%d")
+    y, X, q = make(25, 20)
+    _save("lambdarank", "rank.test", y, X)
+    np.savetxt(os.path.join(HERE, "lambdarank", "rank.test.query"),
+               q, fmt="%d")
+
+
+def parallel(rng):
+    # the parallel example reuses the binary task; the config switches
+    # tree_learner (the reference's 2-machine socket walkthrough becomes
+    # a one-process device-mesh run here)
+    def make(n):
+        X = rng.normal(size=(n, 28))
+        logit = X[:, 0] * X[:, 1] + 0.5 * X[:, 2]
+        y = (logit + 0.4 * rng.normal(size=n) > 0).astype(int)
+        return y, X
+    _save("parallel_learning", "binary.train", *make(7000))
+    _save("parallel_learning", "binary.test", *make(500))
+
+
+if __name__ == "__main__":
+    rng = np.random.default_rng(7)
+    binary(rng)
+    regression(rng)
+    multiclass(rng)
+    lambdarank(rng)
+    parallel(rng)
+    print("example datasets written under", HERE)

@@ -378,6 +378,30 @@ class Config:
             if key in self._raw_params:
                 log.debug("Parameter %s is subsumed by the TPU design: "
                           "%s", key, why)
+        if "device_type" in self._raw_params:
+            # explicit device routing (the reference's CPU/GPU switch,
+            # .ci/test.sh GPU CI pattern): cpu routes the framework's
+            # device selection to the CPU backend; gpu/tpu/cuda run on
+            # the accelerator. The routing lives in module state
+            # (utils/device.py) — an operator's LGBM_TPU_PLATFORM env
+            # pin always outranks it and is never modified.
+            import os as _os
+            from .utils.device import set_config_platform
+            dt = self.device_type.lower()
+            if dt == "cpu":
+                set_config_platform("cpu")
+            elif dt in ("gpu", "cuda", "tpu"):
+                set_config_platform(None)
+                if dt != "tpu":
+                    log.info("device_type=%s maps to the accelerator "
+                             "backend (TPU)", dt)
+            else:
+                log.fatal(f"Unknown device type {self.device_type!r}")
+            pin = _os.environ.get("LGBM_TPU_PLATFORM")
+            if pin and pin != dt and dt != "cpu":
+                log.warning("device_type=%s requested but "
+                            "LGBM_TPU_PLATFORM=%s pins the backend",
+                            dt, pin)
         if self.is_provide_training_metric or self.valid:
             if not self.metric:
                 # force defaults from objective later; handled by metric factory

@@ -13,9 +13,22 @@ from typing import List, Optional
 
 import jax
 
+# platform requested by config (device_type=cpu); the operator's
+# LGBM_TPU_PLATFORM env pin always outranks it
+_config_platform: Optional[str] = None
+
+
+def set_config_platform(platform: Optional[str]) -> None:
+    """Install (or clear, with None) the config-level device routing
+    (device_type). Never touches LGBM_TPU_PLATFORM — an operator pin
+    stays authoritative."""
+    global _config_platform
+    _config_platform = platform
+
 
 def get_devices(platform: Optional[str] = None) -> List:
-    plat = platform or os.environ.get("LGBM_TPU_PLATFORM")
+    plat = (platform or os.environ.get("LGBM_TPU_PLATFORM")
+            or _config_platform)
     if plat:
         return jax.local_devices(backend=plat)
     return jax.devices()

@@ -241,3 +241,41 @@ output_model = {tmp_path}/model.txt
         kv = parse_config_file(conf)
         assert kv["num_trees"] == "7"
         assert kv["metric"] == "auc"
+
+
+class TestOwnExamples:
+    """This repo's own self-contained examples/ (generated data)."""
+
+    @pytest.mark.parametrize("example", [
+        "binary_classification", "regression",
+        "multiclass_classification", "lambdarank", "parallel_learning"])
+    def test_own_example_configs(self, tmp_path, example):
+        import subprocess
+        import sys as _sys
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        exdir = os.path.join(repo, "examples", example)
+        train_file = {
+            "binary_classification": "binary.train",
+            "regression": "regression.train",
+            "multiclass_classification": "multiclass.train",
+            "lambdarank": "rank.train",
+            "parallel_learning": "binary.train",
+        }[example]
+        data = os.path.join(exdir, train_file)
+        if not os.path.exists(data):
+            subprocess.run(
+                [_sys.executable,
+                 os.path.join(repo, "examples", "generate_data.py")],
+                check=True, capture_output=True)
+        conf = os.path.join(exdir, "train.conf")
+        out = str(tmp_path / "model.txt")
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            Application([f"config={conf}", "num_trees=2", "verbose=-1",
+                         f"output_model={out}"]).run()
+        finally:
+            os.chdir(cwd)
+        text = open(out).read()
+        assert text.startswith("tree") and "Tree=" in text

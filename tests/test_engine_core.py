@@ -222,3 +222,25 @@ class TestFeatureImportance:
         g, _, _ = binary_model
         imp = g.feature_importance("gain")
         assert imp.sum() > 0
+
+
+def test_device_type_routing():
+    """Explicit device_type routes the framework's device selection
+    (the reference's CPU/GPU switch); the operator env pin is never
+    touched, unknown values fatal, tpu clears a prior cpu routing."""
+    import os
+    import pytest as _pytest
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils import device
+    from lightgbm_tpu.utils.log import LightGBMError
+    before = os.environ.get("LGBM_TPU_PLATFORM")
+    try:
+        Config().set({"device_type": "cpu"})
+        assert device._config_platform == "cpu"
+        assert os.environ.get("LGBM_TPU_PLATFORM") == before
+        Config().set({"device_type": "tpu"})
+        assert device._config_platform is None
+        with _pytest.raises(LightGBMError):
+            Config().set({"device_type": "banana"})
+    finally:
+        device.set_config_platform(None)
