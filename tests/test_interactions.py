@@ -1,0 +1,55 @@
+"""Config-interaction smoke matrix: boosting variants x sampling x
+quantization x constraints must train, predict finitely, and
+round-trip through the text format. Guards against cross-feature
+regressions no single-feature test sees.
+"""
+import numpy as np
+import pytest
+
+
+COMBOS = [
+    {"boosting": "dart", "bagging_fraction": 0.7, "bagging_freq": 2},
+    {"boosting": "goss", "tpu_quantized_hist": True},
+    {"boosting": "rf", "bagging_fraction": 0.6, "bagging_freq": 1,
+     "feature_fraction": 0.7},
+    {"tpu_quantized_hist": True, "feature_fraction": 0.6,
+     "bagging_fraction": 0.5, "bagging_freq": 3},
+    {"objective": "regression_l1", "tpu_quantized_hist": True},
+    {"objective": "quantile", "alpha": 0.7, "lambda_l1": 0.5},
+    {"tpu_quantized_hist": True,
+     "monotone_constraints": "1,0,-1,0,0,0,0,0"},
+    {"tpu_use_dp": False, "max_depth": 4, "min_gain_to_split": 0.1},
+    {"objective": "poisson", "tpu_quantized_hist": True},
+    {"tpu_quantized_hist": True, "enable_bundle": True},
+]
+
+
+@pytest.fixture(scope="module")
+def xy():
+    r = np.random.default_rng(0)
+    X = r.normal(size=(600, 8))
+    X[::9, 3] = np.nan                # missing values in the mix
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    yr = X[:, 0] + 0.2 * r.normal(size=600)
+    return X, y, yr
+
+
+@pytest.mark.parametrize("extra", COMBOS,
+                         ids=[f"combo{i}" for i in range(len(COMBOS))])
+def test_interaction_smoke(xy, extra):
+    import lightgbm_tpu as lgb
+    X, y, yr = xy
+    params = {"num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 5,
+              "verbose": -1, "objective": "binary", **extra}
+    label = yr if params["objective"] in (
+        "regression_l1", "quantile", "poisson") else y
+    if params["objective"] == "poisson":
+        label = np.abs(label)
+    ds = lgb.Dataset(X, label=label)
+    bst = lgb.train(params, ds, 8)
+    p = np.asarray(bst.predict(X))
+    assert np.isfinite(p).all()
+    s = bst._gbdt.model_to_string()
+    b2 = lgb.Booster(model_str=s)
+    p2 = np.asarray(b2.predict(X, raw_score=True))
+    assert np.isfinite(p2).all()
