@@ -530,9 +530,18 @@ def LGBM_DatasetGetSubset(handle: _DatasetHandle, used_row_indices,
     sub = _DatasetHandle(handle.X[idx],
                          _params_to_config(parameters) if parameters
                          else handle.cfg, handle.reference)
+    n_rows = handle.X.shape[0]
     for k, v in handle.fields.items():
-        if v is not None and k != "group":
-            sub.fields[k] = np.asarray(v)[idx]
+        if v is None or k == "group":
+            continue
+        v = np.asarray(v)
+        if k == "init_score" and v.size != n_rows:
+            # multiclass init_score is stored flattened [K*N]
+            # (column-major by class, c_api.cpp metadata layout):
+            # slice per class then re-flatten
+            sub.fields[k] = v.reshape(-1, n_rows)[:, idx].reshape(-1)
+        else:
+            sub.fields[k] = v[idx]
     grp = handle.fields.get("group")
     if grp is not None:
         # ranking data: the subset must keep whole queries (the

@@ -72,9 +72,11 @@ def _allgather_rowcount(n_local: int) -> int:
         return n_local
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
+    # int32 on the wire: JAX would silently downcast an int64 payload
+    # anyway (x64 disabled); the sum runs in host int64 either way
     counts = multihost_utils.process_allgather(
-        jnp.asarray([n_local], jnp.int64))
-    return int(np.sum(counts))
+        jnp.asarray([n_local], jnp.int32))
+    return int(np.sum(np.asarray(counts, np.int64)))
 
 
 def _allgather_mappers(local: List[Optional[BinMapper]]

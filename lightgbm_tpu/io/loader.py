@@ -261,6 +261,17 @@ class DatasetLoader:
                 Xc = np.pad(Xc, ((0, 0), (0, ncol - Xc.shape[1])),
                             constant_values=pad_value)
             elif Xc.shape[1] > ncol:
+                if fmt == "libsvm":
+                    # pass-1 sized columns from each row's LAST pair;
+                    # exceeding it means some row has non-ascending
+                    # feature indices — truncating would silently drop
+                    # features, so fail loudly instead
+                    log.fatal(
+                        f"two_round: libsvm row block has "
+                        f"{Xc.shape[1]} columns, expected {ncol}; "
+                        "feature indices are not ascending within a "
+                        "row. Sort indices or load with "
+                        "two_round=false")
                 log.warning("two_round: row block has %d columns, "
                             "expected %d; extra columns ignored",
                             Xc.shape[1], ncol)

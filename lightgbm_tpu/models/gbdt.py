@@ -129,6 +129,7 @@ class GBDT:
                           else np.zeros(n, np.float32))
         self._valid_bins_dev: List[jax.Array] = []
         self._stop_check_interval = max(1, config.tpu_stop_check_interval)
+        self._dispatch_sync_interval = config.tpu_dispatch_sync_interval
         self._stopped = False
         # number of leading iteration-groups already verified productive,
         # so each periodic stop check scans only the new tail
@@ -391,6 +392,7 @@ class GBDT:
         from .tree import record_arrays_from_tree
         self.models = loaded_models
         self.records = []
+        self._bump_model_gen()
         self._tree_shrinkage = [m.shrinkage if m.shrinkage else 1.0
                                 for m in loaded_models]
         for t_idx, tree in enumerate(loaded_models):
@@ -640,6 +642,17 @@ class GBDT:
             self._tree_shrinkage.append(shrinkage_for_file)
 
         self.iter_ += 1
+        self._bump_model_gen()
+        sync_iv = self._dispatch_sync_interval
+        if sync_iv > 0 and self.iter_ % sync_iv == 0:
+            # drain the dispatch queue with ONE scalar readback: deep
+            # async queues (hundreds of pending iterations) degrade
+            # sustained throughput ~2.4x on RPC-tunneled backends,
+            # while a bounded queue holds the short-chain rate. A
+            # plain block_until_ready is not sufficient — it has been
+            # observed returning early on the tunneled backend.
+            with timing.phase("train/queue_drain"):
+                np.asarray(recs[-1].num_leaves)
         if self.iter_ % self._stop_check_interval == 0:
             return self._check_stop()
         return False
@@ -674,6 +687,7 @@ class GBDT:
                             rec.leaf_output, -1.0))
             self.iter_ -= 1
         self._clean_groups = min(self._clean_groups, self.iter_)
+        self._bump_model_gen()
 
     def _first_splitless_group(self) -> Optional[int]:
         """Index of the first iteration in which NO class tree could
@@ -743,6 +757,30 @@ class GBDT:
                 self.train_data.used_feature_map, 1.0, L)
             tree.shrinkage = self._tree_shrinkage[i]
             self.models[i] = tree
+
+    def _bump_model_gen(self) -> None:
+        """Invalidate prediction caches — call from every path that
+        mutates the ensemble (train, rollback, refit, load)."""
+        self._model_gen = getattr(self, "_model_gen", 0) + 1
+
+    def _stacked_model(self):
+        """Cached whole-ensemble device predictor (ops/stacked_predict);
+        None when the model shape can't be stacked."""
+        self._ensure_host_trees()
+        key = (getattr(self, "_model_gen", 0), len(self.models))
+        cached = getattr(self, "_stacked_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from ..ops.stacked_predict import StackedModel
+        nf = self.max_feature_idx + 1
+        if nf <= 0 and self.models:
+            nf = max([max(t.split_feature, default=-1)
+                      for t in self.models]) + 1
+        sm = StackedModel(self.models, max(nf, 1),
+                          self.num_tree_per_iteration)
+        sm = sm if sm.ok else None
+        self._stacked_cache = (key, sm)
+        return sm
 
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:414-430). Training may resume
@@ -830,22 +868,12 @@ class GBDT:
             if self.average_output:
                 out /= max((ntree - first) // k, 1)
             return out[0] if k == 1 else out.T
-        if self.train_data is not None and len(self.records) >= ntree:
-            bins_dev = jnp.asarray(self._bin_input(X))
-            acc = jnp.zeros((k, n), jnp.float32)
-            # pairwise-sum trees in chunks: bounds f32 accumulation error
-            # to ~log(T) depth instead of T (reference predicts in double)
-            chunk = 32
-            for cls in range(k):
-                idxs = [t for t in range(first, ntree) if t % k == cls]
-                for c0 in range(0, len(idxs), chunk):
-                    part = []
-                    for t_idx in idxs[c0:c0 + chunk]:
-                        rec = self.records[t_idx]
-                        leaf = replay_partition(rec, bins_dev, self._meta)
-                        part.append(rec.leaf_output[leaf])
-                    acc = acc.at[cls].add(jnp.sum(jnp.stack(part), axis=0))
-            out = np.asarray(acc).astype(np.float64)
+        sm = (self._stacked_model() if (ntree - first) >= 4 and n >= 256
+              else None)
+        if sm is not None:
+            # whole-ensemble MXU scan: one dispatch chain instead of one
+            # replay per tree (ops/stacked_predict.py)
+            out = sm.predict(X, first, ntree).astype(np.float64)
         else:
             self._ensure_host_trees()
             out = np.zeros((k, n), np.float64)
@@ -894,6 +922,10 @@ class GBDT:
         ntree = self._effective_num_models()
         if num_iteration >= 0:
             ntree = min(ntree, num_iteration * self.num_tree_per_iteration)
+        sm = (self._stacked_model() if ntree >= 4 and X.shape[0] >= 256
+              else None)
+        if sm is not None:
+            return sm.predict(X, 0, ntree, pred_leaf=True)
         out = np.zeros((X.shape[0], ntree), np.int32)
         for t in range(ntree):
             out[:, t] = self.models[t].predict_leaf_index(X)
@@ -967,6 +999,7 @@ class GBDT:
                 self._scores = self._scores.at[k].set(new_scores)
                 self.records[t] = rec._replace(leaf_output=out)
                 self.models[t] = None
+        self._bump_model_gen()
         log.info("Refit %d trees with decay_rate=%g", len(self.records),
                  decay)
 
@@ -1178,6 +1211,7 @@ class GBDT:
         # parse trees
         self.models = []
         self.records = []
+        self._bump_model_gen()
         cur: List[str] = []
         for line in lines[i:]:
             t = line.strip()

@@ -263,9 +263,11 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         F_h = root_hist.shape[1]
         if quant:
             # root aggregates from the (dequantized) histogram itself so
-            # every later subtraction stays internally consistent
-            root_g = reduce_fn(jnp.sum(root_hist[0, 0, :, 0]))
-            root_h = reduce_fn(jnp.sum(root_hist[0, 0, :, 1]))
+            # every later subtraction stays internally consistent.
+            # root_hist already passed hist_reduce_fn — no second reduce,
+            # or a distributed reducer would psum twice.
+            root_g = jnp.sum(root_hist[0, 0, :, 0])
+            root_h = jnp.sum(root_hist[0, 0, :, 1])
         else:
             root_g = reduce_fn(jnp.sum(grad))
             root_h = reduce_fn(jnp.sum(hess))

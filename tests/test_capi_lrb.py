@@ -219,3 +219,21 @@ class TestCApiExtended:
         assert capi.LGBM_BoosterRefit(bst) == 0
         p = capi.LGBM_BoosterPredictForMat(bst, X)
         assert ((np.asarray(p) > 0.5) == y).mean() > 0.85
+
+
+def test_subset_multiclass_init_score():
+    """init_score is stored flattened [K*N]; a row subset must slice
+    per class, not by raw flat index (c_api.cpp:430 CopySubset)."""
+    X, _ = _data(n=100)
+    y3 = (np.arange(100) % 3).astype(np.float32)
+    ds = capi.LGBM_DatasetCreateFromMat(
+        X, "objective=multiclass num_class=3")
+    capi.LGBM_DatasetSetField(ds, "label", y3)
+    init = np.arange(300, dtype=np.float64)   # [K=3 * N=100] flattened
+    capi.LGBM_DatasetSetField(ds, "init_score", init)
+    idx = np.array([5, 17, 42, 99])
+    sub = capi.LGBM_DatasetGetSubset(ds, idx)
+    got = np.asarray(sub.fields["init_score"])
+    want = init.reshape(3, 100)[:, idx].reshape(-1)
+    np.testing.assert_array_equal(got, want)
+    assert got.size == 3 * len(idx)

@@ -136,3 +136,21 @@ def test_two_round_libsvm(tmp_path):
         str(f), chunk_rows=37)
     np.testing.assert_array_equal(ds1.bins, ds2.bins)
     np.testing.assert_array_equal(ds1.metadata.label, ds2.metadata.label)
+
+
+def test_two_round_libsvm_nonascending_errors(tmp_path):
+    """Non-ascending feature indices break the pass-1 last-pair column
+    scan; pass 2 must fail loudly instead of silently truncating."""
+    from lightgbm_tpu.io.loader import DatasetLoader
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    f = tmp_path / "bad.svm"
+    with open(f, "w") as fh:
+        for i in range(500):
+            fh.write(f"{i % 2} 0:1.0 1:2.0\n")
+        fh.write("1 5:3.0 2:1.0\n")            # max index NOT last
+    # small sample cap so the malformed line stays OUT of the pass-1
+    # reservoir (otherwise its columns are discovered by the sample)
+    cfg = _cfg(two_round=True, bin_construct_sample_cnt=20)
+    with pytest.raises(LightGBMError, match="not ascending"):
+        DatasetLoader(cfg)._load_two_round(str(f), chunk_rows=16)
