@@ -82,6 +82,8 @@ lgb.train <- function(params = list(), data, nrounds = 10,
   params <- c(params, list(...))
   if (!is.null(obj)) params$objective <- obj
   if (!is.null(eval)) params$metric <- eval
+  evals_result <- if (record) reticulate::py_dict(list(), list())
+                  else NULL
   bst <- lgb$train(
     params = params,
     train_set = data,
@@ -91,9 +93,12 @@ lgb.train <- function(params = list(), data, nrounds = 10,
     init_model = init_model,
     early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL
                             else as.integer(early_stopping_rounds),
+    evals_result = evals_result,
     verbose_eval = if (verbose > 0) as.integer(eval_freq) else FALSE
   )
-  .as_booster(bst)
+  bst <- .as_booster(bst)
+  if (record) attr(bst, "record_evals") <- evals_result
+  bst
 }
 
 #' Cross validation (reference lgb.cv)
@@ -223,4 +228,89 @@ lgb.model.dt.tree <- function(model, num_iteration = NULL) {
     flatten_node(trees[[i]]$tree_structure)
   }))
   rows
+}
+
+#' Per-prediction feature contribution breakdown (reference
+#' lgb.interprete, R-package/R/lgb.interprete.R): for each row in
+#' idxset, a data.frame of features ranked by their SHAP contribution
+#' to that row's prediction.
+#' @param model lgb.Booster
+#' @param data feature matrix the rows are taken from
+#' @param idxset 1-based row indices to interpret
+#' @export
+lgb.interprete <- function(model, data, idxset) {
+  m <- as.matrix(data)[idxset, , drop = FALSE]
+  contrib <- predict.lgb.Booster(model, m, predcontrib = TRUE)
+  contrib <- as.matrix(contrib)
+  nm <- reticulate::py_to_r(model$feature_name())
+  nfeat <- length(nm)
+  nclass <- ncol(contrib) %/% (nfeat + 1L)  # multiclass: K blocks
+  lapply(seq_len(nrow(contrib)), function(i) {
+    row <- contrib[i, ]
+    df <- data.frame(Feature = c(nm, "BIAS"))
+    for (k in seq_len(nclass)) {
+      col <- if (nclass == 1L) "Contribution"
+             else paste0("Contribution_class", k - 1L)
+      off <- (k - 1L) * (nfeat + 1L)
+      df[[col]] <- as.numeric(row[off + seq_len(nfeat + 1L)])
+    }
+    df[order(-abs(df[[2L]])), ]
+  })
+}
+
+#' Barplot of feature importance (reference lgb.plot.importance)
+#' @param tree_imp output of lgb.importance
+#' @param top_n number of features to show
+#' @param measure "Gain" or "Frequency"
+#' @export
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain", ...) {
+  top <- head(tree_imp[order(-tree_imp[[measure]]), ], top_n)
+  # reversed so the largest bar is on top, like the reference's plot
+  graphics::barplot(rev(top[[measure]]), names.arg = rev(top$Feature),
+                    horiz = TRUE, las = 1, main = "Feature importance",
+                    xlab = measure, ...)
+  invisible(top)
+}
+
+#' Barplot of one prediction's contributions (reference
+#' lgb.plot.interpretation)
+#' @param tree_interpretation one element of lgb.interprete's output
+#' @export
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    ...) {
+  top <- head(tree_interpretation, top_n)
+  # column 2 is Contribution (binary/regression) or
+  # Contribution_class0 (multiclass)
+  graphics::barplot(rev(top[[2L]]), names.arg = rev(top$Feature),
+                    horiz = TRUE, las = 1,
+                    main = "Feature contribution", ...)
+  invisible(top)
+}
+
+#' Save a Dataset to the binary cache format (reference
+#' lgb.Dataset.save); reload by passing the file to lgb.Dataset's
+#' Python loader via lgb.train(data = ...)
+#' @export
+lgb.Dataset.save <- function(dataset, fname) {
+  dataset$save_binary(fname)
+  invisible(dataset)
+}
+
+#' Row subset of a Dataset (reference slice.lgb.Dataset); idxset is
+#' 1-based
+#' @export
+lgb.slice.Dataset <- function(dataset, idxset) {
+  ds <- dataset$subset(as.list(as.integer(idxset - 1L)))
+  class(ds) <- c("lgb.Dataset", class(ds))
+  ds
+}
+
+#' Named evaluation log recorded by lgb.train(record = TRUE)
+#' (reference lgb.get.eval.result)
+#' @export
+lgb.get.eval.result <- function(booster, data_name, eval_name) {
+  rec <- attr(booster, "record_evals")
+  if (is.null(rec)) stop("train with record = TRUE to collect evals")
+  reticulate::py_to_r(rec)[[data_name]][[eval_name]]
 }

@@ -583,6 +583,49 @@ class Booster:
                 out.append((name, fname, val, bigger))
         return out
 
+    def eval_dispatch_async(self, include_train: bool):
+        """Dispatch this round's evaluations as device reductions and
+        begin their host copies WITHOUT blocking; returns opaque
+        handles for eval_materialize, or None when any dataset's
+        metrics lack device implementations.
+
+        The engine's training loop uses this to pipeline: iteration
+        i+1's fused step overlaps the RPC that fetches iteration i's
+        metric scalars, so per-iteration evaluation (early stopping)
+        costs latency, not throughput."""
+        idxs = ([(0, self._train_data_name)] if include_train else [])
+        idxs += [(i + 1, nm) for i, nm in enumerate(self.name_valid_sets)]
+        if not idxs:
+            return None
+        g = self._gbdt
+        handles = []
+        for di, name in idxs:
+            metrics = (g.training_metrics if di == 0
+                       else g.valid_metrics[di - 1])
+            fn = g._device_eval_fn(di, metrics)
+            if fn is None:
+                return None
+            scores = (g._scores if di == 0
+                      else g._valid_scores[di - 1])
+            arr = fn(scores)
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+            handles.append((name, metrics, arr))
+        return handles
+
+    @staticmethod
+    def eval_materialize(handles) -> List[tuple]:
+        """Block on eval_dispatch_async handles -> the evaluation
+        result list [(data_name, metric_name, value, bigger_better)]."""
+        out = []
+        for name, metrics, arr in handles:
+            vals = np.asarray(arr)
+            out.extend((name, m.name, float(v), m.bigger_is_better)
+                       for m, v in zip(metrics, vals))
+        return out
+
     def __inner_predict(self, data_idx: int) -> np.ndarray:
         """Raw scores for train (0) or valid set (1..); flattened
         class-major for multiclass like the reference."""

@@ -1,0 +1,176 @@
+"""Embedded-interpreter glue behind the linkable C ABI.
+
+``native/c_api_embed.cpp`` hosts a CPython interpreter and forwards each
+``LGBM_*`` export (reference: src/c_api.cpp:47-1568,
+include/LightGBM/c_api.h) to a function here. The C side passes raw
+buffer addresses as integers; this module wraps them zero-copy with
+numpy/ctypes, calls the Python C-API shim (capi.py — the same engine
+the Python package uses), and writes results straight back into the
+caller's preallocated buffers.
+
+Handles are small integers into a registry (not PyObject pointers), so
+the C side never touches refcounts.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict
+
+import numpy as np
+
+from . import capi
+
+_CT = {0: ctypes.c_float, 1: ctypes.c_double,
+       2: ctypes.c_int32, 3: ctypes.c_int64}
+
+_registry: Dict[int, object] = {}
+_next_id = [1]
+
+
+def _put(obj) -> int:
+    h = _next_id[0]
+    _next_id[0] += 1
+    _registry[h] = obj
+    return h
+
+
+def _get(h: int):
+    return _registry[int(h)]
+
+
+def free_handle(h: int) -> None:
+    _registry.pop(int(h), None)
+
+
+def _arr(ptr: int, n: int, dtype: int) -> np.ndarray:
+    """Zero-copy numpy view of a C buffer."""
+    if n == 0:
+        return np.zeros(0, np.ctypeslib.as_ctypes_type(_CT[dtype]))
+    p = ctypes.cast(int(ptr), ctypes.POINTER(_CT[dtype]))
+    return np.ctypeslib.as_array(p, (int(n),))
+
+
+# --- Dataset ---------------------------------------------------------------
+
+def dataset_from_csr(indptr, indptr_type, indices, data, data_type,
+                     nindptr, nelem, ncol, params, ref) -> int:
+    ip = _arr(indptr, nindptr, indptr_type)
+    ix = _arr(indices, nelem, 2)
+    dv = _arr(data, nelem, data_type)
+    ds = capi.LGBM_DatasetCreateFromCSR(
+        ip, int(indptr_type), ix, dv, int(data_type), int(nindptr),
+        int(nelem), int(ncol), parameters=params,
+        reference=_get(ref) if ref else None)
+    return _put(ds)
+
+
+def dataset_from_mat(data, data_type, nrow, ncol, is_row_major,
+                     params, ref) -> int:
+    flat = _arr(data, int(nrow) * int(ncol), data_type)
+    m = (flat.reshape(nrow, ncol) if is_row_major
+         else flat.reshape(ncol, nrow).T)
+    ds = capi.LGBM_DatasetCreateFromMat(
+        np.ascontiguousarray(m, np.float64), parameters=params,
+        reference=_get(ref) if ref else None)
+    return _put(ds)
+
+
+def dataset_from_file(filename, params, ref) -> int:
+    ds = capi.LGBM_DatasetCreateFromFile(
+        filename, parameters=params,
+        reference=_get(ref) if ref else None)
+    return _put(ds)
+
+
+def dataset_set_field(h, name, data, n, dtype) -> None:
+    capi.LGBM_DatasetSetField(_get(h), name, _arr(data, n, dtype).copy())
+
+
+def dataset_num_data(h) -> int:
+    return int(capi.LGBM_DatasetGetNumData(_get(h)))
+
+
+def dataset_num_feature(h) -> int:
+    return int(capi.LGBM_DatasetGetNumFeature(_get(h)))
+
+
+# --- Booster ---------------------------------------------------------------
+
+def booster_create(train, params) -> int:
+    return _put(capi.LGBM_BoosterCreate(_get(train), params))
+
+
+def booster_from_modelfile(filename, out_iters_ptr) -> int:
+    bst = capi.LGBM_BoosterCreateFromModelfile(filename)
+    n = capi.LGBM_BoosterGetCurrentIteration(bst)
+    _arr(out_iters_ptr, 1, 2)[0] = int(n)
+    return _put(bst)
+
+
+def booster_merge(h, other) -> None:
+    capi.LGBM_BoosterMerge(_get(h), _get(other))
+
+
+def booster_add_valid(h, valid) -> None:
+    capi.LGBM_BoosterAddValidData(_get(h), _get(valid))
+
+
+def booster_update(h, out_ptr) -> None:
+    fin = capi.LGBM_BoosterUpdateOneIter(_get(h))
+    _arr(out_ptr, 1, 2)[0] = int(bool(fin))
+
+
+def booster_refit(h, leaf_preds, nrow, ncol) -> None:
+    lp = _arr(leaf_preds, int(nrow) * int(ncol), 2).reshape(nrow, ncol)
+    capi.LGBM_BoosterRefit(_get(h), lp)
+
+
+def booster_calc_num_predict(h, num_row, predict_type,
+                             num_iteration) -> int:
+    return int(capi.LGBM_BoosterCalcNumPredict(
+        _get(h), int(num_row), int(predict_type), int(num_iteration)))
+
+
+def booster_predict_csr(h, indptr, indptr_type, indices, data,
+                        data_type, nindptr, nelem, ncol, predict_type,
+                        num_iteration, params, out_result) -> int:
+    ip = _arr(indptr, nindptr, indptr_type)
+    ix = _arr(indices, nelem, 2)
+    dv = _arr(data, nelem, data_type)
+    res = capi.LGBM_BoosterPredictForCSR(
+        _get(h), ip, int(indptr_type), ix, dv, int(data_type),
+        int(nindptr), int(nelem), int(ncol),
+        predict_type=int(predict_type),
+        num_iteration=int(num_iteration), parameter=params)
+    flat = np.asarray(res, np.float64).reshape(-1)
+    _arr(out_result, flat.size, 1)[:] = flat
+    return int(flat.size)
+
+
+def booster_predict_mat(h, data, data_type, nrow, ncol, is_row_major,
+                        predict_type, num_iteration, params,
+                        out_result) -> int:
+    flat = _arr(data, int(nrow) * int(ncol), data_type)
+    m = (flat.reshape(nrow, ncol) if is_row_major
+         else flat.reshape(ncol, nrow).T)
+    res = capi.LGBM_BoosterPredictForMat(
+        _get(h), np.ascontiguousarray(m, np.float64),
+        predict_type=int(predict_type),
+        num_iteration=int(num_iteration), parameter=params)
+    out = np.asarray(res, np.float64).reshape(-1)
+    _arr(out_result, out.size, 1)[:] = out
+    return int(out.size)
+
+
+def booster_save_model(h, start_iteration, num_iteration,
+                       filename) -> None:
+    capi.LGBM_BoosterSaveModel(_get(h), num_iteration=int(num_iteration),
+                               filename=filename,
+                               start_iteration=int(start_iteration))
+
+
+def booster_get_eval(h, data_idx, out_results) -> int:
+    pairs = capi.LGBM_BoosterGetEval(_get(h), int(data_idx))
+    vals = np.asarray([v for _, v in pairs], np.float64)
+    _arr(out_results, vals.size, 1)[:] = vals
+    return int(vals.size)

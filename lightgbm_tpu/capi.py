@@ -733,11 +733,28 @@ def LGBM_NetworkInit(machines: str, local_listen_port: int,
 
 
 def LGBM_NetworkFree():
+    from .parallel.learners import set_network_functions
+    set_network_functions()             # clear injected collectives
     return 0
 
 
-def LGBM_NetworkInitWithFunctions(*_args, **_kw):
-    raise LightGBMError(
-        "custom reduce functions cannot be injected: collectives are "
-        "compiled into the XLA program (use tree_learner= to pick the "
-        "communication pattern)")
+def LGBM_NetworkInitWithFunctions(num_machines: int, rank: int,
+                                  reduce_scatter_fn=None,
+                                  allgather_fn=None):
+    """network.cpp:41-54 — install external collective functions.
+
+    The reference injects C function pointers that move raw byte
+    buffers; the TPU engine's collectives are XLA ops compiled into the
+    training program, so the injected callables here are jax-traceable
+    wrappers ``fn(value, default_collective) -> value`` invoked at every
+    collective site when the distributed learners trace (histogram
+    reduce-scatter = psum sites, best-split sync = all_gather site).
+    They can observe, extend, or fully replace the default collective —
+    the seam SURVEY §2.2 asks to keep for tests."""
+    from .parallel.learners import set_network_functions
+    set_network_functions(reduce_scatter_fn=reduce_scatter_fn,
+                          allgather_fn=allgather_fn)
+    log.info("NetworkInitWithFunctions: collective overrides installed "
+             "(num_machines=%s rank=%s come from the JAX runtime)",
+             num_machines, rank)
+    return 0

@@ -147,32 +147,66 @@ def _train_loop(booster, params, init_iteration, num_boost_round,
                 callbacks_before_iter, callbacks_after_iter, fobj,
                 feval, valid_sets, is_valid_contain_train):
     evaluation_result_list: List[tuple] = []
-    for i in range(init_iteration, init_iteration + num_boost_round):
+    want_eval = valid_sets is not None or feval is not None
+    # pipelined evaluation: when every metric evaluates on device
+    # (Booster.eval_dispatch_async), iteration i's metric scalars are
+    # fetched WHILE iteration i+1 computes, so per-round evaluation
+    # (early stopping) costs RPC latency, not training throughput.
+    # Custom fevals need host scores -> synchronous path.
+    pipelined = want_eval and feval is None
+    end_iteration = init_iteration + num_boost_round
+    pending = None                    # (iteration, async eval handles)
+
+    def run_after_cbs(iteration, results):
+        """True = early stop (the extra lookahead iteration, if any,
+        is trimmed by the caller)."""
+        nonlocal evaluation_result_list
+        evaluation_result_list = results
+        try:
+            for cb in callbacks_after_iter:
+                cb(callback.CallbackEnv(
+                    model=booster, params=params, iteration=iteration,
+                    begin_iteration=init_iteration,
+                    end_iteration=end_iteration,
+                    evaluation_result_list=results))
+        except callback.EarlyStopException as early_stop:
+            booster.best_iteration = early_stop.best_iteration + 1
+            evaluation_result_list = early_stop.best_score
+            return True
+        return False
+
+    for i in range(init_iteration, end_iteration):
         for cb in callbacks_before_iter:
             cb(callback.CallbackEnv(
                 model=booster, params=params, iteration=i,
                 begin_iteration=init_iteration,
-                end_iteration=init_iteration + num_boost_round,
+                end_iteration=end_iteration,
                 evaluation_result_list=None))
 
         booster.update(fobj=fobj)
 
-        evaluation_result_list = []
-        if valid_sets is not None or feval is not None:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after_iter:
-                cb(callback.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=init_iteration,
-                    end_iteration=init_iteration + num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback.EarlyStopException as early_stop:
-            booster.best_iteration = early_stop.best_iteration + 1
-            evaluation_result_list = early_stop.best_score
-            break
+        handles = (booster.eval_dispatch_async(is_valid_contain_train)
+                   if pipelined else None)
+        if handles is None:
+            pipelined = False
+            results = []
+            if want_eval:
+                if is_valid_contain_train:
+                    results.extend(booster.eval_train(feval))
+                results.extend(booster.eval_valid(feval))
+            if run_after_cbs(i, results):
+                return evaluation_result_list
+            continue
+        if pending is not None:
+            pi, ph = pending
+            if run_after_cbs(pi, booster.eval_materialize(ph)):
+                # the lookahead iteration trained past the stop point
+                booster.rollback_one_iter()
+                return evaluation_result_list
+        pending = (i, handles)
+    if pending is not None:
+        pi, ph = pending
+        run_after_cbs(pi, booster.eval_materialize(ph))
     return evaluation_result_list
 
 

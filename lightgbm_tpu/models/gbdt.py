@@ -172,13 +172,17 @@ class GBDT:
         self._mesh = mesh
         self._learner_mode = mode
         D = mesh.devices.size if mesh is not None else 1
-        # EFB is wired through the serial grower's seams only; parallel
-        # modes train on the unbundled member columns
+        # EFB rides the histogram seam (bundle columns in, member
+        # histograms out) and the meta-driven partition decode, both of
+        # which compose with the serial grower AND the row-sharded
+        # data/voting learners. Feature-parallel shards columns, which
+        # the bundle->member expansion does not slice; it trains on
+        # unbundled member columns.
         self._use_bundles = (self.train_data.bundles is not None
-                             and mode == "serial")
+                             and mode in ("serial", "data", "voting"))
         if self.train_data.bundles is not None and not self._use_bundles:
-            log.warning("EFB bundling is only used with "
-                        "tree_learner=serial; training on unbundled "
+            log.warning("EFB bundling is not used with "
+                        "tree_learner=feature; training on unbundled "
                         "columns")
             self._meta = self._meta._replace(
                 bundle=np.zeros((), np.int32),
@@ -229,11 +233,11 @@ class GBDT:
         # (measured 1.7s vs 83ms per tree at 1M rows). hi/lo f32-grade
         # accumulation (tpu_use_dp) needs 5W <= 128 -> W = 24; single
         # bf16 fused needs 4W <= 128 -> W = 32.
-        quant = (cfg.tpu_quantized_hist and mode == "serial"
-                 and not self._use_bundles)
+        quant = (cfg.tpu_quantized_hist
+                 and mode in ("serial", "data", "voting"))
         if cfg.tpu_quantized_hist and not quant:
-            log.warning("tpu_quantized_hist needs tree_learner=serial "
-                        "without EFB bundles; using %s histograms",
+            log.warning("tpu_quantized_hist is not supported with "
+                        "tree_learner=feature; using %s histograms",
                         "f32-grade" if cfg.tpu_use_dp else "bf16")
         if quant:
             precision, w_cap = "int8", 40    # 3ch cap 42, 8-aligned 40
@@ -272,11 +276,13 @@ class GBDT:
             db_m = jnp.asarray(meta.default_bin)
             B_out = gcfg.num_bins
 
-            def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
+            def hist_fn(bins_t, g, h, leaf_ids, wave_leaves,
+                        gh_scale=None):
                 bh = wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
                                     num_bins=Bb, chunk=gcfg.chunk,
                                     use_pallas=gcfg.use_pallas,
-                                    precision=gcfg.precision)
+                                    precision=gcfg.precision,
+                                    gh_scale=gh_scale)
                 return expand_bundle_histogram(bh, mb, mo, nb_m, db_m,
                                                B_out)
         self._grower = make_grower_for_mode(
@@ -794,7 +800,13 @@ class GBDT:
 
     def get_eval_at(self, data_idx: int) -> List[tuple]:
         """Returns [(metric_name, value, bigger_better)] for dataset
-        data_idx (0 = train, 1.. = valid)."""
+        data_idx (0 = train, 1.. = valid).
+
+        When every metric for the dataset has a device implementation
+        (metrics/metric.py device_eval_builder), evaluation runs as ONE
+        jitted reduction and only len(metrics) scalars cross the wire —
+        per-iteration eval (early stopping) no longer downloads the
+        full [K, N] score tensor."""
         out = []
         if data_idx == 0:
             scores = self._scores
@@ -803,11 +815,34 @@ class GBDT:
             scores = self._valid_scores[data_idx - 1]
             metrics = self.valid_metrics[data_idx - 1]
         with timing.phase("eval/metrics"):
+            fn = self._device_eval_fn(data_idx, metrics)
+            if fn is not None:
+                vals = np.asarray(fn(scores))
+                return [(m.name, float(v), m.bigger_is_better)
+                        for m, v in zip(metrics, vals)]
             raw = np.asarray(scores)
             for m in metrics:
                 for name, val in m.eval(raw, self.objective):
                     out.append((name, val, m.bigger_is_better))
         return out
+
+    def _device_eval_fn(self, data_idx: int, metrics):
+        """Jitted scores -> stacked metric scalars, cached per dataset;
+        None when any metric lacks a device implementation."""
+        cache = getattr(self, "_dev_eval_fns", None)
+        if cache is None:
+            cache = self._dev_eval_fns = {}
+        if data_idx in cache:
+            return cache[data_idx]
+        fn = None
+        if metrics:
+            builders = [m.device_eval_builder(self.objective)
+                        for m in metrics]
+            if all(b is not None for b in builders):
+                fn = jax.jit(
+                    lambda s: jnp.stack([b(s) for b in builders]))
+        cache[data_idx] = fn
+        return fn
 
     # -- prediction ---------------------------------------------------------
 

@@ -26,6 +26,7 @@ host->device upload per row chunk and ~T/TC fused scan steps.
 """
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import List, Optional
 
@@ -33,6 +34,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ..io.binning import MissingType
 from ..utils import log
@@ -134,11 +137,40 @@ class StackedModel:
                 over = (np.nextafter(edges[-1], np.inf)
                         if edges.size else 0.0)
                 rep = np.concatenate([edges, [over, np.nan]])
-            widths[f] = rep.size
+            # widths 8-aligned: the Pallas forest kernel builds the
+            # one-hot on the sublane axis in per-feature blocks, and
+            # Mosaic wants 8-aligned sublane starts; padded slots have
+            # all-zero W rows and are never addressed by a code
+            widths[f] = -(-rep.size // 8) * 8
             reps.append(rep)
+        self._rep_sizes = np.array([r.size for r in reps], np.int64)
         self._offsets = np.concatenate([[0], np.cumsum(widths)])
         Wtot = int(self._offsets[-1])
         self._Wtot = Wtot
+
+        # device-binning fast path (numerical features only): f32 edges
+        # rounded DOWN so an f32 row compares exactly like f64 against
+        # the f64 threshold (x <= t  <=>  x <= largest-f32 <= t, for
+        # f32-representable x)
+        self._dev_bin_ok = not any(c is not None for c in self._cats)
+        if self._dev_bin_ok:
+            m_max = max((e.size for e in self._edges if e is not None),
+                        default=0)
+            E = np.full((F, max(m_max, 1)), np.inf, np.float32)
+            for f in range(F):
+                e = self._edges[f]
+                if e is None or e.size == 0:
+                    continue
+                ef = e.astype(np.float32)
+                bump = ef.astype(np.float64) > e
+                ef[bump] = np.nextafter(ef[bump], -np.inf)
+                E[f, :e.size] = ef
+            self._E_f32 = E
+            self._nan_slot = np.array(
+                [self._offsets[f] + self._rep_sizes[f] - 1
+                 for f in range(F)],
+                np.int32)
+            self._off32 = self._offsets[:F].astype(np.int32)
 
         # 3. decision tables, ancestor matrix, targets, leaf values
         W = np.zeros((Wtot, T, S), np.int8)
@@ -151,7 +183,8 @@ class StackedModel:
             for s in range(nl - 1):
                 f = t.split_feature[s]
                 o = self._offsets[f]
-                W[o:o + widths[f], ti, s] = _node_table(t, s, reps[f])
+                W[o:o + self._rep_sizes[f], ti, s] = _node_table(
+                    t, s, reps[f])
             # DFS: signed ancestor matrix + per-leaf left-count target
             if nl == 1:
                 tgt[ti, 0] = 0.0
@@ -201,10 +234,15 @@ class StackedModel:
                 nan = np.isnan(x)
                 neg = ~nan & (x < 0)
                 cat = np.trunc(np.where(nan | neg, 0, x))
-                pos = np.searchsorted(cs, cat)
-                pos = np.clip(pos, 0, cs.size - 1) if cs.size else pos * 0
-                known = (cs.size > 0) & (cs[np.minimum(
-                    pos, max(cs.size - 1, 0))] == cat)
+                if cs.size:
+                    pos = np.clip(np.searchsorted(cs, cat),
+                                  0, cs.size - 1)
+                    known = cs[pos] == cat
+                else:
+                    # empty bitset (all categories go right): every
+                    # value maps to the "other" slot
+                    pos = np.zeros(N, np.int64)
+                    known = np.zeros(N, bool)
                 b = np.where(known, pos, cs.size)       # other
                 b = np.where(nan | neg, cs.size + 1, b)  # neg/NaN slot
             else:
@@ -216,8 +254,11 @@ class StackedModel:
             codes[:, f] = o + b
         return codes
 
-    def _device_arrays(self, first: int, ntree: int):
-        key = (first, ntree)
+    def _stack_range(self, key, first: int, ntree: int, Sp: int,
+                     Lp: int, tgt_dtype):
+        """Shared stacker for the scan (Sp=S, Lp=L) and Pallas
+        (MXU-tile-padded) layouts: slice the tree range, pad to a TC
+        multiple, and shape [steps, ...] chunk stacks."""
         hit = self._dev_cache.get(key)
         if hit is not None:
             return hit
@@ -229,6 +270,7 @@ class StackedModel:
         nt = ntree - first
         steps = -(-nt // TC)
         pad = steps * TC - nt
+        S, L = self._S, self._L
         sl = slice(first, ntree)
 
         def padT(a, fill=0.0):
@@ -243,13 +285,20 @@ class StackedModel:
         if pad:
             W = np.concatenate(
                 [W, np.zeros((pad,) + W.shape[1:], np.int8)])
-        W = (W.reshape(steps, TC, self._Wtot, self._S)
+        W = np.pad(W, ((0, 0), (0, 0), (0, Sp - S)))
+        W = (W.reshape(steps, TC, self._Wtot, Sp)
               .transpose(0, 2, 1, 3)
-              .reshape(steps, self._Wtot, TC * self._S))
-        P = padT(self._P_host).reshape(steps, TC, self._S, self._L)
-        tgt = padT(self._tgt_host, 1e9).reshape(
-            steps, TC, self._L)
-        leaf = padT(self._leaf_host).reshape(steps, TC, self._L)
+              .reshape(steps, self._Wtot, TC * Sp))
+        P = np.pad(padT(self._P_host),
+                   ((0, 0), (0, Sp - S), (0, Lp - L)))
+        P = P.reshape(steps, TC, Sp, Lp)
+        tgt = np.pad(padT(self._tgt_host, 1e9).astype(np.float64),
+                     ((0, 0), (0, Lp - L)), constant_values=1e9)
+        if tgt_dtype == np.int32:
+            tgt = np.minimum(tgt, 2 ** 30)
+        tgt = tgt.astype(tgt_dtype).reshape(steps, TC, Lp)
+        leaf = np.pad(padT(self._leaf_host),
+                      ((0, 0), (0, Lp - L))).reshape(steps, TC, Lp)
         cls = (np.arange(first, first + steps * TC) % self.num_class)
         clsOH = np.eye(self.num_class, dtype=np.float32)[cls].reshape(
             steps, TC, self.num_class)
@@ -260,34 +309,93 @@ class StackedModel:
         self._dev_cache[key] = out
         return out
 
+    def _device_arrays(self, first: int, ntree: int):
+        return self._stack_range((first, ntree), first, ntree,
+                                 self._S, self._L, np.float32)
+
     def predict(self, X: np.ndarray, first: int = 0,
                 ntree: Optional[int] = None,
                 pred_leaf: bool = False,
-                row_chunk: int = 65536) -> np.ndarray:
+                row_chunk: int = 262144,
+                use_pallas: Optional[bool] = None) -> np.ndarray:
         """Raw scores [K, N] (or leaf indices [N, ntree-first] int32)."""
         ntree = self.num_trees if ntree is None else ntree
         X = np.ascontiguousarray(np.asarray(X, np.float64))
-        codes = self._bin_rows(X)
-        dev = self._device_arrays(first, ntree)
+        Fm = len(self._offsets) - 1
+        # device binning when rows are f32-exact and all-numerical:
+        # skips the host searchsorted pass AND halves the upload.
+        # Probe a small sample first so ineligible inputs (true f64
+        # data) don't pay a full-matrix round-trip scan.
+        dev_bin = self._dev_bin_ok and X.shape[1] >= Fm
+        if dev_bin:
+            probe = X[:64, :Fm]
+            dev_bin = _f32_exact(probe, probe.astype(np.float32))
+        rows = None
+        if dev_bin:
+            Xf = X[:, :Fm].astype(np.float32)
+            dev_bin = _f32_exact(X[:, :Fm], Xf)
+            rows = Xf if dev_bin else None
+        if rows is None:
+            rows = self._bin_rows(X)
         N = X.shape[0]
+        from ..utils.device import on_tpu
+        forest = (use_pallas if use_pallas is not None else on_tpu())
+        # VMEM guard: the kernel's one-hot tile and W block scale with
+        # the total feature width; very wide models (many features x
+        # max_bin 255) exceed the VMEM budget — use the XLA scan path
+        forest = forest and self._Wtot <= 8192
+        if forest and not pred_leaf:
+            # fused forest kernel: the whole ensemble in ONE dispatch
+            dev = self._device_arrays_pallas(first, ntree)
+            offs = tuple(int(o) for o in self._offsets)
+            if dev_bin:
+                acc = forest_predict_from_x(
+                    jnp.asarray(rows), jnp.asarray(self._E_f32),
+                    jnp.asarray(self._off32),
+                    jnp.asarray(self._nan_slot), *dev,
+                    offsets=offs, interpret=not on_tpu())
+            else:
+                codes_t = jnp.asarray(np.ascontiguousarray(rows.T))
+                acc = forest_predict_pallas(
+                    codes_t, *dev, offsets=offs,
+                    interpret=not on_tpu())
+            return np.asarray(acc).T.astype(np.float64)
+        dev = self._device_arrays(first, ntree)
         # pad rows to a power-of-two bucket so repeated odd-sized calls
         # reuse one compiled kernel instead of recompiling per shape
         bucket = min(row_chunk, max(256, 1 << (N - 1).bit_length()))
         pad = (-N) % bucket
         if pad:
-            codes = np.concatenate([codes, np.zeros(
-                (pad, codes.shape[1]), np.int32)])
+            rows = np.concatenate([rows, np.zeros(
+                (pad, rows.shape[1]), rows.dtype)])
         outs = []
         for c0 in range(0, N + pad, bucket):
-            chunk = codes[c0:c0 + bucket]
-            outs.append(_run_chunk(jnp.asarray(chunk), *dev,
-                                   self._Wtot, pred_leaf))
+            chunk = jnp.asarray(rows[c0:c0 + bucket])
+            if dev_bin:
+                outs.append(_run_chunk_from_x(
+                    chunk, jnp.asarray(self._E_f32),
+                    jnp.asarray(self._off32),
+                    jnp.asarray(self._nan_slot), *dev,
+                    self._Wtot, pred_leaf))
+            else:
+                outs.append(_run_chunk(chunk, *dev,
+                                       self._Wtot, pred_leaf))
         if pred_leaf:
             out = np.concatenate([np.asarray(o) for o in outs], axis=0)
             return out[:N, :ntree - first]
         return np.concatenate(
             [np.asarray(o) for o in outs],
             axis=0)[:N].T.astype(np.float64)
+
+
+    def _device_arrays_pallas(self, first: int, ntree: int):
+        """Kernel-shaped stacks: per-tree axes padded to MXU tiles
+        (S -> Sp multiple of 128 so per-tree lane slices of C are
+        aligned; L -> Lp for the second dot's output lanes)."""
+        Sp = -(-self._S // 128) * 128
+        Lp = -(-self._L // 128) * 128
+        return self._stack_range(("pallas", first, ntree), first,
+                                 ntree, Sp, Lp, np.int32)
 
 
 class _FallbackError(Exception):
@@ -324,10 +432,50 @@ def _node_table(tree, s: int, reps: np.ndarray) -> np.ndarray:
     return go_left.astype(np.int8)
 
 
+@jax.jit
+def _codes_from_x(x, E, off32, nan_slot):
+    """f32 rows -> feature-major global one-hot codes on device."""
+    bins = jnp.sum(x[:, :, None] > E[None], axis=2).astype(jnp.int32)
+    codes = jnp.where(jnp.isnan(x), nan_slot[None], off32[None] + bins)
+    return codes.T
+
+
+@functools.partial(jax.jit, static_argnames=("offsets", "interpret"))
+def forest_predict_from_x(x, E, off32, nan_slot, W, P, tgt, leaf, cls,
+                          *, offsets, interpret=False):
+    """Device binning + forest kernel in ONE dispatch."""
+    codes_t = _codes_from_x(x, E, off32, nan_slot)
+    return forest_predict_pallas(codes_t, W, P, tgt, leaf, cls,
+                                 offsets=offsets, interpret=interpret)
+
+
+def _f32_exact(X64: np.ndarray, X32: np.ndarray) -> bool:
+    """True when every finite value round-trips f64 -> f32 -> f64."""
+    with np.errstate(invalid="ignore"):
+        same = (X32.astype(np.float64) == X64) | np.isnan(X64)
+    return bool(same.all())
+
+
+@partial(jax.jit, static_argnums=(9, 10))
+def _run_chunk_from_x(x, E, off32, nan_slot, W, P, tgt, leaf, clsOH,
+                      Wtot: int, pred_leaf: bool):
+    """f32 rows -> codes on device (edges pre-rounded so the f32
+    compare reproduces the host's f64 searchsorted exactly), then the
+    shared kernel."""
+    bins = jnp.sum(x[:, :, None] > E[None], axis=2).astype(jnp.int32)
+    codes = jnp.where(jnp.isnan(x), nan_slot[None],
+                      off32[None] + bins)
+    return _kernel(codes, W, P, tgt, leaf, clsOH, Wtot, pred_leaf)
+
+
 @partial(jax.jit, static_argnums=(6, 7))
 def _run_chunk(codes, W, P, tgt, leaf, clsOH, Wtot: int,
                pred_leaf: bool):
     """codes [n, F] int32 -> scores [n, K] f32 (or leaf idx [n, T])."""
+    return _kernel(codes, W, P, tgt, leaf, clsOH, Wtot, pred_leaf)
+
+
+def _kernel(codes, W, P, tgt, leaf, clsOH, Wtot: int, pred_leaf: bool):
     n = codes.shape[0]
     from ..utils.device import on_tpu
     # int8 / bf16 feed the MXU's fast paths; the CPU backend's dot
@@ -363,3 +511,107 @@ def _run_chunk(codes, W, P, tgt, leaf, clsOH, Wtot: int,
     if pred_leaf:
         return jnp.moveaxis(ys, 0, 1).reshape(n, -1)
     return acc
+
+
+# --- fused forest kernel ---------------------------------------------------
+#
+# The XLA scan above materializes the node-decision matrix C and the
+# ancestor-agreement counts E in HBM between its three contractions;
+# at 500 trees x 1M rows that traffic alone costs more than the math.
+# The Pallas kernel keeps the whole chain in VMEM: build the one-hot
+# tile from codes, run both int8 MXU dots, fuse the match compare and
+# leaf-value reduction, and emit ONLY the [N, K] score accumulator.
+# One dispatch for the entire forest.
+
+def _forest_kernel(codes_ref, W_ref, P_ref, tgt_ref, leaf_ref, cls_ref,
+                   acc_ref, *, F, Wtot, offs, TC, Sp, Lp, K, nt):
+    i32 = jnp.int32
+    step = pl.program_id(1)
+
+    # Grid is (rows, steps) steps-inner: each [nt, K] accumulator block
+    # is visited in CONSECUTIVE iterations (a Pallas requirement for
+    # read-modify-write output blocks; a steps-outer order interleaves
+    # visits and loses partial sums). The W/P re-fetch per row tile is
+    # ~4 MB x steps — cheap at a 2048-row tile.
+    # One-hot tile [Wtot, nt] int8, rebuilt per iteration:
+    # nt*Wtot compares — noise next to the dots.
+    blocks = []
+    for f in range(F):
+        w = offs[f + 1] - offs[f]
+        row = codes_ref[f, :].astype(i32) - offs[f]
+        iota = jax.lax.broadcasted_iota(i32, (w, 1), 0)
+        blocks.append((row[None, :] == iota).astype(jnp.int8))
+    oh = jnp.concatenate(blocks, axis=0)                 # [Wtot, nt]
+
+    # dot 1: every node decision for every row, int8 MXU
+    C = jax.lax.dot_general(
+        oh, W_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=i32)                      # [nt, TC*Sp]
+    C8 = C.astype(jnp.int8)                              # values {0,1}
+
+    # dot 2 per tree + fused match/value reduction
+    vals = []
+    for t in range(TC):
+        Ct = C8[:, t * Sp:(t + 1) * Sp]
+        E = jax.lax.dot_general(
+            Ct, P_ref[0, t], (((1,), (0,)), ((), ())),
+            preferred_element_type=i32)                  # [nt, Lp]
+        match = (E == tgt_ref[0, t][None, :]).astype(jnp.float32)
+        vals.append(jnp.sum(match * leaf_ref[0, t][None, :],
+                            axis=1, keepdims=True))      # [nt, 1]
+    val = jnp.concatenate(vals, axis=1)                  # [nt, TC]
+    contrib = jax.lax.dot_general(
+        val, cls_ref[0], (((1,), (0,)), ((), ())),
+        # f32 MXU default truncates operands to bf16 — keep the class
+        # scatter exact (tiny dot, cost is nil)
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)              # [nt, K]
+
+    @pl.when(step == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+    acc_ref[...] += contrib
+
+
+@functools.partial(jax.jit, static_argnames=("offsets", "row_tile",
+                                             "interpret"))
+def forest_predict_pallas(codes_t, W, P, tgt, leaf, cls, *, offsets,
+                          row_tile=2048, interpret=False):
+    """codes_t [F, N] int32 -> scores [N, K] f32, one fused dispatch."""
+    F, N = codes_t.shape
+    steps, Wtot, TCSp = W.shape
+    _, TC, Sp, Lp = P.shape
+    K = cls.shape[-1]
+    pad = (-N) % row_tile
+    if pad:
+        # padded rows get code 0 -> garbage scores, sliced off below
+        codes_t = jnp.pad(codes_t, ((0, 0), (0, pad)))
+    n_pad = N + pad
+    kernel = functools.partial(
+        _forest_kernel, F=F, Wtot=Wtot, offs=tuple(offsets), TC=TC,
+        Sp=Sp, Lp=Lp, K=K, nt=row_tile)
+    acc = pl.pallas_call(
+        kernel,
+        grid=(n_pad // row_tile, steps),
+        in_specs=[
+            pl.BlockSpec((F, row_tile), lambda r, t: (0, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Wtot, TCSp), lambda r, t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TC, Sp, Lp), lambda r, t: (t, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TC, Lp), lambda r, t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TC, Lp), lambda r, t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TC, K), lambda r, t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((row_tile, K), lambda r, t: (r, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, K), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(codes_t, W, P, tgt, leaf, cls)
+    return acc[:N]

@@ -154,9 +154,15 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     # learners inject their own hist/partition seams)
     default_seams = (hist_fn is None and partition_fn is None)
     quant = cfg.precision == "int8"
-    if quant and not default_seams:
-        raise ValueError("int8 quantized histograms need the default "
-                         "(serial, unbundled) seams")
+    if quant and hist_fn is not None:
+        # an injected histogram seam must understand quantized g/h —
+        # silently dropping gh_scale would produce garbage histograms
+        import inspect
+        if "gh_scale" not in inspect.signature(hist_fn).parameters:
+            raise ValueError(
+                "int8 quantized histograms need a hist_fn that "
+                "accepts gh_scale (see the EFB bundle seam, "
+                "models/gbdt.py)")
     use_fused = cfg.fused
     if use_fused is None:
         from .hist_wave import (FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO,
@@ -257,17 +263,20 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         root_wl = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.full(W - 1, -1, jnp.int32)])
         leaf0 = jnp.zeros(n, jnp.int32)
-        root_hist = hist_reduce_fn(
-            call_hist(bins_t, bag_mask_ids(leaf0),
-                      root_wl))                          # [W, F, B, 3]
+        local_root = call_hist(bins_t, bag_mask_ids(leaf0),
+                               root_wl)                  # [W, F, B, 3]
+        root_hist = hist_reduce_fn(local_root)
         F_h = root_hist.shape[1]
         if quant:
             # root aggregates from the (dequantized) histogram itself so
-            # every later subtraction stays internally consistent.
-            # root_hist already passed hist_reduce_fn — no second reduce,
-            # or a distributed reducer would psum twice.
-            root_g = jnp.sum(root_hist[0, 0, :, 0])
-            root_h = jnp.sum(root_hist[0, 0, :, 1])
+            # every later subtraction stays internally consistent. Sum
+            # the PRE-reduction local histogram and apply the scalar
+            # reducer: correct whether the mode reduces histograms
+            # (data: hist_reduce=psum, reduce=psum would double-count a
+            # post-reduction sum) or scalars only (voting: hist_reduce
+            # is identity and the local sum NEEDS the psum).
+            root_g = reduce_fn(jnp.sum(local_root[0, 0, :, 0]))
+            root_h = reduce_fn(jnp.sum(local_root[0, 0, :, 1]))
         else:
             root_g = reduce_fn(jnp.sum(grad))
             root_h = reduce_fn(jnp.sum(hess))

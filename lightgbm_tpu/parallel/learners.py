@@ -54,6 +54,34 @@ from ..ops.wave_grower import WaveGrowerConfig, make_wave_grower
 
 AXIS = "workers"
 
+# Injectable collective overrides — the TPU-native analog of the
+# reference's external-collective seam (src/network/network.cpp:41-54,
+# LGBM_NetworkInitWithFunctions): tests and embedders can wrap or
+# replace the histogram reduce-scatter and best-split allgather.
+# An override is fn(value, default_collective) -> value and must be
+# jax-traceable; it runs at trace time, once per collective site per
+# compilation (collectives are compiled into the XLA program, so the
+# seam observes/extends tracing rather than per-step execution).
+_collective_overrides: dict = {}
+
+
+def set_network_functions(reduce_scatter_fn=None,
+                          allgather_fn=None) -> None:
+    """Install (or with both None, clear) collective overrides."""
+    _collective_overrides.clear()
+    if reduce_scatter_fn is not None:
+        _collective_overrides["reduce_scatter"] = reduce_scatter_fn
+    if allgather_fn is not None:
+        _collective_overrides["allgather"] = allgather_fn
+
+
+def _psum_seam(x):
+    """Histogram/scalar reduction through the injectable seam."""
+    def base(v):
+        return jax.lax.psum(v, AXIS)
+    ov = _collective_overrides.get("reduce_scatter")
+    return ov(x, base) if ov is not None else base(x)
+
 
 def make_mesh(num_devices: Optional[int] = None) -> Mesh:
     from ..utils.device import get_devices
@@ -66,7 +94,11 @@ def sync_best_splits(res: SplitResult) -> SplitResult:
     """Cross-device argmax of per-device best-split batches — the analog
     of SyncUpGlobalBestSplit (parallel_tree_learner.h:183-207) over a
     whole wave of children at once."""
-    gathered = jax.lax.all_gather(res, AXIS)      # pytree of [D, M, ...]
+    def base(v):
+        return jax.lax.all_gather(v, AXIS)
+    ov = _collective_overrides.get("allgather")
+    gathered = (ov(res, base) if ov is not None
+                else base(res))                   # pytree of [D, M, ...]
     best = jnp.argmax(gathered.gain, axis=0)      # [M]
     m = best.shape[0]
     return SplitResult(*[leaf[best, jnp.arange(m)] for leaf in gathered])
@@ -90,7 +122,7 @@ def _hist(cfg: WaveGrowerConfig):
 
 
 def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
-                              mesh: Mesh):
+                              mesh: Mesh, hist_fn=None):
     """Rows sharded over the mesh; wave histograms psummed.
 
     (DataParallelTreeLearner semantics; the reference reduce-scatters so
@@ -104,9 +136,13 @@ def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     only the [W, F, B, 3] histograms cross ICI.
     """
     def reduce_fn(x):
-        return jax.lax.psum(x, AXIS)
+        return _psum_seam(x)
 
-    grow = make_wave_grower(cfg, meta, hist_reduce_fn=reduce_fn,
+    # hist_fn (e.g. the EFB bundle-expansion seam) composes: each shard
+    # histograms its own rows through it, then the expanded [W, F, B, 3]
+    # rides the psum exactly like the default seam's output
+    grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
+                            hist_reduce_fn=reduce_fn,
                             reduce_fn=reduce_fn, jit=False)
     sharded = jax.shard_map(
         grow, mesh=mesh,
@@ -155,7 +191,7 @@ def make_feature_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
 
 def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                 mesh: Mesh, num_features: int,
-                                top_k: int = 20):
+                                top_k: int = 20, hist_fn=None):
     """Data-parallel with PV-Tree vote compression
     (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp:166-360):
     per child, local top-k vote -> elect 2k global features -> psum only
@@ -175,7 +211,7 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     # partition+histogram kernel live per shard (its output is exactly
     # the local wave histogram the election wants).
     def reduce_fn(x):
-        return jax.lax.psum(x, AXIS)
+        return _psum_seam(x)
 
     def split_fn(hists, sg, sh, nd, fmask, can):
         # 1. local per-feature gains over the LOCAL histograms with the
@@ -196,14 +232,14 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         m = local_gain.shape[0]
         votes = jnp.zeros((m, num_features), jnp.float32)
         votes = votes.at[jnp.arange(m)[:, None], local_top].add(1.0)
-        votes = jax.lax.psum(votes, AXIS)
+        votes = _psum_seam(votes)
         # exact lexicographic (votes, summed-local-gain) election: rank
         # the gain sums 0..F-1 per child, then score = votes*F + rank —
         # deterministic, no saturating squash
         # gated features contribute 0 (not -inf: one device's gate must
         # not veto a feature other devices can still split)
         finite_gain = jnp.where(jnp.isfinite(local_gain), local_gain, 0.0)
-        gain_sum = jax.lax.psum(finite_gain, AXIS)
+        gain_sum = _psum_seam(finite_gain)
         order = jnp.argsort(gain_sum, axis=1)             # low -> high
         rank = jnp.zeros_like(order).at[
             jnp.arange(m)[:, None], order].set(
@@ -211,9 +247,9 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         score = votes * num_features + rank.astype(jnp.float32)
         _, elected = jax.lax.top_k(score, k2)             # [M, 2k]
         # 3. aggregate ONLY the elected features' histograms
-        elected_hist = jax.lax.psum(
+        elected_hist = _psum_seam(
             jnp.take_along_axis(
-                hists, elected[:, :, None, None], axis=1), AXIS)
+                hists, elected[:, :, None, None], axis=1))
         meta_e = FeatureMeta(*[
             a if jnp.ndim(a) == 0 else a[elected]
             for a in meta_dev])                               # [M, 2k]
@@ -234,7 +270,8 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     axis=1)[:, 0],
                 -1))
 
-    grow = make_wave_grower(cfg, meta, split_fn=split_fn,
+    grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
+                            split_fn=split_fn,
                             reduce_fn=reduce_fn, jit=False)
     sharded = jax.shard_map(
         grow, mesh=mesh,
@@ -255,10 +292,13 @@ def make_grower_for_mode(mode: str, cfg: WaveGrowerConfig,
     if mode == "serial" or mesh is None or mesh.devices.size == 1:
         return make_wave_grower(cfg, meta, hist_fn=hist_fn)
     if mode == "data":
-        return make_data_parallel_grower(cfg, meta, mesh)
+        return make_data_parallel_grower(cfg, meta, mesh, hist_fn=hist_fn)
     if mode == "feature":
+        if hist_fn is not None:
+            raise ValueError("feature-parallel does not compose with an "
+                             "injected histogram seam (EFB bundles)")
         return make_feature_parallel_grower(cfg, meta, mesh, num_features)
     if mode == "voting":
         return make_voting_parallel_grower(cfg, meta, mesh, num_features,
-                                           top_k)
+                                           top_k, hist_fn=hist_fn)
     raise ValueError(f"Unknown tree_learner {mode!r}")

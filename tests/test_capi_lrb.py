@@ -203,8 +203,14 @@ class TestCApiExtended:
     def test_network_and_error_state(self):
         assert capi.LGBM_NetworkInit("127.0.0.1:12400", 12400, 120, 1) == 0
         assert capi.LGBM_NetworkFree() == 0
-        with pytest.raises(Exception):
-            capi.LGBM_NetworkInitWithFunctions(1, 2)
+        # the external-collective seam (network.cpp:41-54) installs and
+        # clears overrides (tests/test_parallel.py exercises them live)
+        from lightgbm_tpu.parallel.learners import _collective_overrides
+        assert capi.LGBM_NetworkInitWithFunctions(
+            1, 2, reduce_scatter_fn=lambda x, d: d(x)) == 0
+        assert "reduce_scatter" in _collective_overrides
+        assert capi.LGBM_NetworkFree() == 0
+        assert not _collective_overrides
         capi.LGBM_SetLastError("boom")
         assert capi.LGBM_GetLastError() == "boom"
 

@@ -1,0 +1,130 @@
+"""Device-side metric reductions (metrics/metric.py device_eval_builder).
+
+The reference evaluates metrics on host scores (gbdt.cpp:432-534); here
+scores live on device, so per-iteration eval (early stopping) runs as a
+jitted reduction and downloads one scalar per metric. These tests pin
+device values against the f64 host implementations.
+"""
+import numpy as np
+import pytest
+
+from conftest import TEST_PARAMS, fit_gbdt, make_binary
+
+
+def _parity(metric_names, objective, y, scores, weights=None, num_class=1):
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import create_metrics
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.io.dataset import Metadata
+
+    n = y.shape[0]
+    cfg = Config().set({"objective": objective, "num_class": num_class})
+    md = Metadata(label=y, weight=weights)
+    mets = create_metrics(metric_names, cfg, md, n)
+    obj = create_objective(objective, cfg)
+    obj.init(md, n)
+    raw = np.asarray(scores, np.float64)
+    # padded scores: device path must ignore the pad columns
+    pad = np.concatenate([scores, np.full((scores.shape[0], 7), 1e9,
+                                          np.float32)], axis=1)
+    for m in mets:
+        b = m.device_eval_builder(obj)
+        assert b is not None, m.name
+        got = float(b(jnp.asarray(pad)))
+        (_, want), = m.eval(raw, obj)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
+                                   err_msg=m.name)
+
+
+def test_binary_metrics_parity():
+    r = np.random.default_rng(0)
+    n = 5000
+    y = (r.random(n) > 0.4).astype(np.float32)
+    s = r.normal(size=(1, n)).astype(np.float32)
+    s[0, :50] = s[0, 50:100]                 # score ties for AUC groups
+    _parity(["auc", "binary_logloss", "binary_error"], "binary", y, s)
+    w = r.uniform(0.5, 2.0, n).astype(np.float32)
+    _parity(["auc", "binary_logloss", "binary_error"], "binary", y, s,
+            weights=w)
+
+
+def test_regression_metrics_parity():
+    r = np.random.default_rng(1)
+    n = 4000
+    y = r.normal(size=n).astype(np.float32)
+    s = (y + 0.3 * r.normal(size=n)).astype(np.float32)[None]
+    _parity(["l2", "rmse", "l1"], "regression", y, s)
+    w = r.uniform(0.1, 3.0, n).astype(np.float32)
+    _parity(["l2", "rmse", "l1"], "regression", y, s, weights=w)
+
+
+def test_multiclass_metrics_parity():
+    r = np.random.default_rng(2)
+    n, k = 3000, 4
+    y = r.integers(0, k, n).astype(np.float32)
+    s = r.normal(size=(k, n)).astype(np.float32)
+    _parity(["multi_logloss", "multi_error"], "multiclass", y, s,
+            num_class=k)
+
+
+def test_training_uses_device_eval():
+    """get_eval_at routes through the jitted device reduction when all
+    metrics support it, and matches a host re-evaluation."""
+    X, y = make_binary(n=1500, f=6, seed=31)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary",
+                            metric="auc,binary_logloss"), num_round=8)
+    assert g._device_eval_fn(0, g.training_metrics) is not None
+    got = {n: v for n, v, _ in g.get_eval_at(0)}
+    raw = np.asarray(g._scores)
+    for m in g.training_metrics:
+        for name, want in m.eval(raw, g.objective):
+            np.testing.assert_allclose(got[name], want, rtol=2e-5,
+                                       err_msg=name)
+
+
+def test_unsupported_metric_falls_back_to_host():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import create_metrics
+    from lightgbm_tpu.io.dataset import Metadata
+
+    y = np.zeros(100, np.float32)
+    cfg = Config().set({"objective": "regression"})
+    (m,) = create_metrics(["huber"], cfg, Metadata(label=y), 100)
+    assert m.device_eval_builder(None) is None
+
+
+def test_pipelined_early_stopping_matches_sync():
+    """The engine's pipelined (one-iteration-lookahead) evaluation must
+    stop at the same best_iteration as the synchronous path, and trim
+    the lookahead iteration from the model."""
+    import lightgbm_tpu as lgb
+
+    X, y = make_binary(n=1600, f=6, seed=41)
+    Xv, yv = make_binary(n=500, f=6, seed=42)
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+              "max_bin": 63, "learning_rate": 0.3, "verbose": -1}
+
+    def run(force_sync):
+        from lightgbm_tpu.basic import Booster
+        ds = lgb.Dataset(X, label=y, params=params)
+        dv = ds.create_valid(Xv, label=yv)
+        orig = Booster.eval_dispatch_async
+        if force_sync:
+            Booster.eval_dispatch_async = lambda self, inc: None
+        try:
+            return lgb.train(params, ds, 80, valid_sets=[dv],
+                             callbacks=[lgb.early_stopping(
+                                 5, verbose=False)],
+                             verbose_eval=False,
+                             keep_training_booster=True)
+        finally:
+            Booster.eval_dispatch_async = orig
+
+    fast = run(False)
+    slow = run(True)
+    assert fast.best_iteration == slow.best_iteration
+    # lookahead iteration was rolled back: at most best + patience trees
+    assert fast.num_trees() == slow.num_trees()
+    np.testing.assert_allclose(
+        fast.predict(Xv[:100]), slow.predict(Xv[:100]), atol=1e-6)

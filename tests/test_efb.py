@@ -97,3 +97,66 @@ class TestBundling:
                                                     reference=train),
                   verbose_eval=False, evals_result=ev)
         assert ev["valid_0"]["auc"][-1] > 0.97
+
+
+class TestBundleComposition:
+    """EFB composing with the quantized histogram path and the
+    row-sharded distributed learners (the reference's GPU path bundles
+    dense groups and offloads, gpu_tree_learner.cpp:325-357)."""
+
+    def _train(self, X, y, **extra):
+        params = {"objective": "binary", "verbose": -1,
+                  "num_leaves": 15, "min_data_in_leaf": 5,
+                  "enable_bundle": True, **extra}
+        return lgb.train(params,
+                         lgb.Dataset(X, y, params=params), 12,
+                         verbose_eval=False,
+                         keep_training_booster=True)
+
+    def test_quantized_hist_with_bundles(self):
+        """Bundling composes with int8 quantized histograms and costs
+        no quality vs the unbundled quantized run. (Bit-exact parity is
+        not the bar: the default-bin complement `total - rest` sums
+        dequantized floats in a different order than the direct member
+        histogram, so near-tie splits may flip — same as the
+        reference's own EFB.)"""
+        X, y = _sparse_problem()
+        b = self._train(X, y, tpu_quantized_hist=True)
+        g = b._gbdt
+        assert g._use_bundles
+        assert g._grower_cfg.precision == "int8"
+        b_ref = lgb.train(
+            {"objective": "binary", "verbose": -1, "num_leaves": 15,
+             "min_data_in_leaf": 5, "enable_bundle": False,
+             "tpu_quantized_hist": True},
+            lgb.Dataset(X, y, params={"enable_bundle": False}), 12,
+            verbose_eval=False)
+        acc_b = ((b.predict(X) > 0.5) == y).mean()
+        acc_u = ((b_ref.predict(X) > 0.5) == y).mean()
+        assert acc_b >= acc_u - 0.005
+
+    @pytest.mark.skipif(
+        len(__import__("jax").devices()) < 2, reason="needs mesh")
+    def test_data_parallel_with_bundles_matches_serial(self):
+        X, y = _sparse_problem()
+        b_ser = self._train(X, y)
+        b_par = self._train(X, y, tree_learner="data")
+        g = b_par._gbdt
+        assert g._use_bundles and g._learner_mode == "data"
+        np.testing.assert_allclose(
+            b_par.predict(X[:300], raw_score=True),
+            b_ser.predict(X[:300], raw_score=True),
+            rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.skipif(
+        len(__import__("jax").devices()) < 2, reason="needs mesh")
+    def test_voting_and_quant_data_with_bundles(self):
+        X, y = _sparse_problem()
+        bv = self._train(X, y, tree_learner="voting", top_k=5)
+        assert bv._gbdt._use_bundles
+        assert ((bv.predict(X) > 0.5) == y).mean() > 0.95
+        bq = self._train(X, y, tree_learner="data",
+                         tpu_quantized_hist=True)
+        assert bq._gbdt._use_bundles
+        assert bq._gbdt._grower_cfg.precision == "int8"
+        assert ((bq.predict(X) > 0.5) == y).mean() > 0.95

@@ -157,3 +157,57 @@ class TestFusedDistributed:
         # 256 rows/shard with a 5-feature vote is the reference's
         # "small data per machine" regime — approximation costs a hair
         assert _auc(g) > 0.96
+
+
+class TestCollectiveInjection:
+    """The external-collective seam (network.cpp:41-54,
+    LGBM_NetworkInitWithFunctions): injected wrappers observe/replace
+    the learners' collectives."""
+
+    def test_counting_reducer_observes_psum_sites(self):
+        from lightgbm_tpu import capi
+        calls = {"rs": 0, "ag": 0}
+
+        def counting_reduce(x, default):
+            calls["rs"] += 1
+            return default(x)
+
+        def counting_allgather(x, default):
+            calls["ag"] += 1
+            return default(x)
+
+        capi.LGBM_NetworkInitWithFunctions(
+            8, 0, reduce_scatter_fn=counting_reduce,
+            allgather_fn=counting_allgather)
+        try:
+            X, y = make_binary(640)
+            g = fit_gbdt(X, y, {"objective": "binary", "metric": "auc",
+                                "tree_learner": "data"}, num_round=3)
+            assert g._learner_mode == "data"
+            assert calls["rs"] > 0          # psum sites traced through
+        finally:
+            capi.LGBM_NetworkFree()
+        from lightgbm_tpu.parallel.learners import _collective_overrides
+        assert not _collective_overrides   # NetworkFree cleared the seam
+
+    def test_replacing_reducer_changes_result(self):
+        """A replacing override (ignores the default collective) must
+        actually flow into the compiled program: scaling every reduction
+        by 1 device-count leaves a single-shard... instead verify a
+        broken reducer (identity, no psum) degrades data-parallel into
+        shard-local training — trees differ from the proper run."""
+        from lightgbm_tpu import capi
+        X, y = make_binary(640)
+        proper = fit_gbdt(X, y, {"objective": "binary",
+                                 "tree_learner": "data"}, num_round=3)
+        capi.LGBM_NetworkInitWithFunctions(
+            8, 0, reduce_scatter_fn=lambda x, default: x)
+        try:
+            broken = fit_gbdt(X, y, {"objective": "binary",
+                                     "tree_learner": "data"},
+                              num_round=3)
+        finally:
+            capi.LGBM_NetworkFree()
+        a = proper.predict_raw(X[:100])
+        b = broken.predict_raw(X[:100])
+        assert np.abs(a - b).max() > 1e-6
