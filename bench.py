@@ -66,6 +66,13 @@ def main():
     if args.quick:
         args.rows, args.iters, args.leaves = 65_536, 20, 63
 
+    import jax
+    # persistent compile cache: the grower/predict kernels compile once
+    # per machine instead of once per process (~30-60 s saved per run)
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/lgbm_tpu_jax_cache_dev")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import TpuDataset, Metadata
     from lightgbm_tpu.models.gbdt import GBDT

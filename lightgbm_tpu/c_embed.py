@@ -14,6 +14,7 @@ the C side never touches refcounts.
 from __future__ import annotations
 
 import ctypes
+import itertools
 from typing import Dict
 
 import numpy as np
@@ -24,12 +25,13 @@ _CT = {0: ctypes.c_float, 1: ctypes.c_double,
        2: ctypes.c_int32, 3: ctypes.c_int64}
 
 _registry: Dict[int, object] = {}
-_next_id = [1]
+# itertools.count is atomic under the GIL: concurrent C-side callers
+# (the .so drops the GIL between calls) never share a handle id
+_next_id = itertools.count(1)
 
 
 def _put(obj) -> int:
-    h = _next_id[0]
-    _next_id[0] += 1
+    h = next(_next_id)
     _registry[h] = obj
     return h
 

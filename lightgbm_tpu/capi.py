@@ -110,6 +110,19 @@ def _csr_to_dense(indptr, indices, data, num_col: int) -> np.ndarray:
     indices = np.asarray(indices, np.int64)
     data = np.asarray(data, np.float64)
     n = len(indptr) - 1
+    # dense-only engine (SURVEY §7): the reference keeps CSR through
+    # sampling (c_api.cpp:506); here sparse input densifies, which is a
+    # memory CLIFF for genuinely sparse data — warn before allocating
+    # (EFB re-compresses exclusive columns once binned)
+    dense_gb = n * num_col * 8 / 2 ** 30
+    if dense_gb > 4.0:
+        nnz = data.size
+        log.warning(
+            "densifying %dx%d sparse input to %.1f GiB (nnz=%d, "
+            "density %.4f): the TPU engine is dense-only; consider "
+            "enable_bundle=true (EFB) or fewer columns",
+            n, num_col, dense_gb, nnz,
+            nnz / max(n * num_col, 1))
     X = np.zeros((n, num_col), np.float64)
     rows = np.repeat(np.arange(n), np.diff(indptr))
     X[rows, indices[:len(rows)]] = data[:len(rows)]

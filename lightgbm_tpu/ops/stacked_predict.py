@@ -369,13 +369,15 @@ class StackedModel:
             rows = np.concatenate([rows, np.zeros(
                 (pad, rows.shape[1]), rows.dtype)])
         outs = []
+        if dev_bin:     # upload the edge tables once, not per chunk
+            E_d = jnp.asarray(self._E_f32)
+            off_d = jnp.asarray(self._off32)
+            nan_d = jnp.asarray(self._nan_slot)
         for c0 in range(0, N + pad, bucket):
             chunk = jnp.asarray(rows[c0:c0 + bucket])
             if dev_bin:
                 outs.append(_run_chunk_from_x(
-                    chunk, jnp.asarray(self._E_f32),
-                    jnp.asarray(self._off32),
-                    jnp.asarray(self._nan_slot), *dev,
+                    chunk, E_d, off_d, nan_d, *dev,
                     self._Wtot, pred_leaf))
             else:
                 outs.append(_run_chunk(chunk, *dev,
@@ -461,10 +463,9 @@ def _run_chunk_from_x(x, E, off32, nan_slot, W, P, tgt, leaf, clsOH,
                       Wtot: int, pred_leaf: bool):
     """f32 rows -> codes on device (edges pre-rounded so the f32
     compare reproduces the host's f64 searchsorted exactly), then the
-    shared kernel."""
-    bins = jnp.sum(x[:, :, None] > E[None], axis=2).astype(jnp.int32)
-    codes = jnp.where(jnp.isnan(x), nan_slot[None],
-                      off32[None] + bins)
+    shared kernel. The codes computation is shared with the Pallas
+    path (_codes_from_x) so the binning semantics cannot diverge."""
+    codes = _codes_from_x(x, E, off32, nan_slot).T
     return _kernel(codes, W, P, tgt, leaf, clsOH, Wtot, pred_leaf)
 
 

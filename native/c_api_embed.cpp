@@ -38,23 +38,34 @@ PyObject* g_glue = nullptr;            // lightgbm_tpu.c_embed module
 thread_local std::string g_last_error = "everything is fine";
 
 bool ensure_python() {
-  std::lock_guard<std::mutex> lock(g_init_mutex);
+  // fast path: already initialized (pointer write is release-ordered
+  // by the mutex below; a stale null just takes the slow path)
   if (g_glue != nullptr) return true;
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    // release the init-time GIL or every later PyGILState_Ensure from
-    // another thread (thread-pool consumers) deadlocks
-    PyEval_SaveThread();
+  {
+    // interpreter bootstrap only — do NOT hold this mutex while
+    // acquiring the GIL, or a GIL-holding caller racing first-time
+    // init deadlocks (lock-order inversion)
+    std::lock_guard<std::mutex> lock(g_init_mutex);
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the init-time GIL or every later PyGILState_Ensure
+      // from another thread (thread-pool consumers) deadlocks
+      PyEval_SaveThread();
+    }
   }
   PyGILState_STATE st = PyGILState_Ensure();
-  g_glue = PyImport_ImportModule("lightgbm_tpu.c_embed");
-  if (g_glue == nullptr) {
-    PyObject *t, *v, *tb;
-    PyErr_Fetch(&t, &v, &tb);
-    PyObject* s = v ? PyObject_Str(v) : nullptr;
-    g_last_error = std::string("cannot import lightgbm_tpu.c_embed: ") +
-                   (s ? PyUnicode_AsUTF8(s) : "unknown");
-    Py_XDECREF(s); Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+  if (g_glue == nullptr) {   // re-check under the GIL (it serializes)
+    PyObject* mod = PyImport_ImportModule("lightgbm_tpu.c_embed");
+    if (mod == nullptr) {
+      PyObject *t, *v, *tb;
+      PyErr_Fetch(&t, &v, &tb);
+      PyObject* s = v ? PyObject_Str(v) : nullptr;
+      g_last_error = std::string("cannot import lightgbm_tpu.c_embed: ")
+                     + (s ? PyUnicode_AsUTF8(s) : "unknown");
+      Py_XDECREF(s); Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+    } else {
+      g_glue = mod;
+    }
   }
   PyGILState_Release(st);
   return g_glue != nullptr;
