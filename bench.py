@@ -62,6 +62,13 @@ def main():
     ap.add_argument("--no-quant", action="store_true",
                     help="disable int8 histogram quantization "
                          "(f32-grade hi/lo accumulation instead)")
+    ap.add_argument("--learner", default="serial",
+                    choices=["serial", "data", "voting"],
+                    help="tree learner: 'data' shards rows over every "
+                         "visible chip and psums wave histograms over "
+                         "ICI — the multi-chip path for the v5e-8 "
+                         "north-star target (falls back to serial on "
+                         "one device)")
     args = ap.parse_args()
     if args.quick:
         args.rows, args.iters, args.leaves = 65_536, 20, 63
@@ -97,6 +104,7 @@ def main():
         # stochastically-rounded int8 g/h at 2x MXU rate (the train-AUC
         # printed below shows quality parity with the f32 path)
         "tpu_quantized_hist": not args.no_quant,
+        "tree_learner": args.learner,
     })
     t0 = time.time()
     ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
@@ -142,7 +150,9 @@ def main():
     result = {
         "metric": ("HIGGS-class GBDT training throughput "
                    f"({args.rows} rows x 28 feat, {args.leaves} leaves, "
-                   f"{args.max_bin} bins, {args.iters} iters, 1 chip)"),
+                   f"{args.max_bin} bins, {args.iters} iters, "
+                   f"{g._mesh.devices.size if g._mesh is not None else 1}"
+                   " chip(s))"),
         "value": round(row_iters_per_s / 1e6, 3),
         "unit": "M row-iters/s",
         "vs_baseline": round(row_iters_per_s / BASELINE_ROW_ITERS_PER_S, 3),

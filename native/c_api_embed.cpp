@@ -20,6 +20,7 @@
 
 #include <Python.h>
 
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <mutex>
@@ -34,13 +35,14 @@ typedef void* BoosterHandle;
 namespace {
 
 std::mutex g_init_mutex;
-PyObject* g_glue = nullptr;            // lightgbm_tpu.c_embed module
+// lightgbm_tpu.c_embed module; atomic so the lock-free fast path is a
+// well-defined acquire read against the GIL-held publishing store
+std::atomic<PyObject*> g_glue{nullptr};
 thread_local std::string g_last_error = "everything is fine";
 
 bool ensure_python() {
-  // fast path: already initialized (pointer write is release-ordered
-  // by the mutex below; a stale null just takes the slow path)
-  if (g_glue != nullptr) return true;
+  // fast path: a stale null just takes the slow path
+  if (g_glue.load(std::memory_order_acquire) != nullptr) return true;
   {
     // interpreter bootstrap only — do NOT hold this mutex while
     // acquiring the GIL, or a GIL-holding caller racing first-time
@@ -54,28 +56,39 @@ bool ensure_python() {
     }
   }
   PyGILState_STATE st = PyGILState_Ensure();
-  if (g_glue == nullptr) {   // re-check under the GIL (it serializes)
+  if (g_glue.load(std::memory_order_relaxed) == nullptr) {
+    // re-check under the GIL (it serializes importers)
     PyObject* mod = PyImport_ImportModule("lightgbm_tpu.c_embed");
     if (mod == nullptr) {
       PyObject *t, *v, *tb;
       PyErr_Fetch(&t, &v, &tb);
       PyObject* s = v ? PyObject_Str(v) : nullptr;
-      g_last_error = std::string("cannot import lightgbm_tpu.c_embed: ")
-                     + (s ? PyUnicode_AsUTF8(s) : "unknown");
+      const char* msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+      if (msg == nullptr) {
+        PyErr_Clear();           // AsUTF8 can fail on odd messages
+        msg = "unknown";
+      }
+      g_last_error =
+          std::string("cannot import lightgbm_tpu.c_embed: ") + msg;
       Py_XDECREF(s); Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
     } else {
-      g_glue = mod;
+      g_glue.store(mod, std::memory_order_release);
     }
   }
   PyGILState_Release(st);
-  return g_glue != nullptr;
+  return g_glue.load(std::memory_order_acquire) != nullptr;
 }
 
 void capture_error() {
   PyObject *t, *v, *tb;
   PyErr_Fetch(&t, &v, &tb);
   PyObject* s = v ? PyObject_Str(v) : nullptr;
-  g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  const char* msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (msg == nullptr) {
+    PyErr_Clear();               // AsUTF8 can fail on odd messages
+    msg = "unknown python error";
+  }
+  g_last_error = msg;
   Py_XDECREF(s); Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
 }
 
@@ -100,7 +113,8 @@ PyObject* call(const char* fn, const char* fmt, ...) {
   va_end(ap);
   PyObject* out = nullptr;
   if (args != nullptr) {
-    PyObject* f = PyObject_GetAttrString(g_glue, fn);
+    PyObject* f = PyObject_GetAttrString(
+        g_glue.load(std::memory_order_acquire), fn);
     if (f != nullptr) {
       out = PyObject_CallObject(f, args);
       Py_DECREF(f);
@@ -121,7 +135,8 @@ int call_void(const char* fn, const char* fmt, ...) {
   va_end(ap);
   PyObject* out = nullptr;
   if (args != nullptr) {
-    PyObject* f = PyObject_GetAttrString(g_glue, fn);
+    PyObject* f = PyObject_GetAttrString(
+        g_glue.load(std::memory_order_acquire), fn);
     if (f != nullptr) {
       out = PyObject_CallObject(f, args);
       Py_DECREF(f);
