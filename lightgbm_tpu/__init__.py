@@ -21,8 +21,10 @@ except ImportError:          # scikit-learn not installed
 
 # plotting imports matplotlib lazily inside each function, so the
 # module itself always imports
-from .plotting import plot_importance, plot_metric, plot_tree
-_PLOT_EXPORTS = ["plot_importance", "plot_metric", "plot_tree"]
+from .plotting import (create_tree_digraph, plot_importance,
+                       plot_metric, plot_tree)
+_PLOT_EXPORTS = ["create_tree_digraph", "plot_importance",
+                 "plot_metric", "plot_tree"]
 
 __version__ = "0.3.0"
 

@@ -49,7 +49,8 @@ _ZERO_EPS = 1e-35
 # more distinct categories than this fall back to the host path
 MAX_FEATURE_WIDTH = 1024
 TREE_CHUNK = 16    # trees per scan/grid step (TC=16 measured ~10%
-                   # faster than 8 at the 500-tree bench shape)
+                   # faster than 8 at the 500-tree bench shape; wide
+                   # models drop to 8 so the W block stays in VMEM)
 
 
 class StackedModel:
@@ -267,7 +268,7 @@ class StackedModel:
         # would otherwise pin one device copy of W/P per tree range
         while len(self._dev_cache) >= 4:
             self._dev_cache.pop(next(iter(self._dev_cache)))
-        TC = min(TREE_CHUNK, max(ntree - first, 1))
+        TC = min(self._tree_chunk(), max(ntree - first, 1))
         nt = ntree - first
         steps = -(-nt // TC)
         pad = steps * TC - nt
@@ -310,6 +311,11 @@ class StackedModel:
         self._dev_cache[key] = out
         return out
 
+    def _tree_chunk(self) -> int:
+        """Trees per step: halved for wide models so the Pallas W block
+        (Wtot x TC*Sp int8, double-buffered) stays within VMEM."""
+        return TREE_CHUNK if self._Wtot <= 4096 else TREE_CHUNK // 2
+
     def _device_arrays(self, first: int, ntree: int):
         return self._stack_range((first, ntree), first, ntree,
                                  self._S, self._L, np.float32)
@@ -343,9 +349,10 @@ class StackedModel:
         forest = (use_pallas if use_pallas is not None else on_tpu())
         # VMEM guard: the kernel's one-hot tile and W block scale with
         # the total feature width (W block alone is Wtot x TC*Sp int8,
-        # double-buffered); very wide models exceed the VMEM budget —
-        # use the XLA scan path instead of crashing the fused kernel
-        forest = forest and self._Wtot <= 4096
+        # double-buffered). Mid-width models halve TC (_tree_chunk);
+        # truly wide ones use the XLA scan path instead of crashing
+        # the fused kernel.
+        forest = forest and self._Wtot <= 8192
         if forest and not pred_leaf:
             # fused forest kernel: the whole ensemble in ONE dispatch
             dev = self._device_arrays_pallas(first, ntree)

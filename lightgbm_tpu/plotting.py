@@ -134,6 +134,21 @@ def plot_metric(booster, metric=None, dataset_names=None, ax=None,
     return ax
 
 
+def _split_desc(node, names, precision):
+    """Shared split-node text: feature-name fallback + threshold
+    rounding used by both tree renderers."""
+    feat = node["split_feature"]
+    fname = names[feat] if feat < len(names) else f"f{feat}"
+    op = node.get("decision_type", "<=")
+    return f"{fname} {op} {round(node['threshold'], precision)}"
+
+
+def _leaf_desc(node, precision):
+    """Shared leaf text: (index, rounded value)."""
+    return (node.get("leaf_index", 0),
+            round(node.get("leaf_value", 0.0), precision))
+
+
 def plot_tree(booster, ax=None, tree_index=0, figsize=None,
               show_info=None, precision=3):
     """Render one tree's structure with matplotlib (plotting.py:384-449
@@ -177,11 +192,7 @@ def plot_tree(booster, ax=None, tree_index=0, figsize=None,
         x, y = positions[id(node)]
         info = show_info or []
         if "split_index" in node:
-            feat = node["split_feature"]
-            fname = names[feat] if feat < len(names) else f"f{feat}"
-            op = node.get("decision_type", "<=")
-            label = (f"{fname} {op} "
-                     f"{round(node['threshold'], precision)}\n"
+            label = (f"{_split_desc(node, names, precision)}\n"
                      f"gain={round(node.get('split_gain', 0.0), precision)}")
             for key in ("internal_count", "internal_value"):
                 if key in info and key in node:
@@ -192,8 +203,8 @@ def plot_tree(booster, ax=None, tree_index=0, figsize=None,
                 ax.plot([x, cx], [y, cy], "k-", lw=0.8, zorder=1)
                 draw(child)
         else:
-            label = (f"leaf {node.get('leaf_index', 0)}:\n"
-                     f"{round(node.get('leaf_value', 0.0), precision)}")
+            li, lv = _leaf_desc(node, precision)
+            label = f"leaf {li}:\n{lv}"
             if "leaf_count" in info and "leaf_count" in node:
                 label += f"\ncount={node['leaf_count']}"
             box = dict(boxstyle="round", fc="lightgreen", ec="black")
@@ -204,3 +215,54 @@ def plot_tree(booster, ax=None, tree_index=0, figsize=None,
     ax.set_axis_off()
     ax.set_title(f"Tree {tree_index}")
     return ax
+
+
+def create_tree_digraph(booster, tree_index=0, show_info=None,
+                        precision=3, name=None, comment=None, **kwargs):
+    """One tree as a graphviz Digraph (reference plotting.py:311-381
+    create_tree_digraph; node content matches _to_graphviz:257-308).
+    ``show_info`` from {'split_gain', 'internal_value', 'internal_count',
+    'leaf_count'}."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz to plot tree.")
+    if hasattr(booster, "booster_"):
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel")
+    model = booster.dump_model()
+    if tree_index >= len(model["tree_info"]):
+        raise IndexError("tree_index is out of range")
+    tree = model["tree_info"][tree_index]["tree_structure"]
+    names = model["feature_names"]
+    info = show_info or []
+
+    graph = Digraph(name=name, comment=comment, **kwargs)
+
+    def add(node, parent=None, decision=None):
+        if "split_index" in node:
+            nid = f"split{node['split_index']}"
+            label = _split_desc(node, names, precision)
+            if "split_gain" in info:
+                label += f"\ngain: {round(node.get('split_gain', 0.0), precision)}"
+            if "internal_value" in info and "internal_value" in node:
+                label += f"\nvalue: {round(node['internal_value'], precision)}"
+            if "internal_count" in info and "internal_count" in node:
+                label += f"\ncount: {node['internal_count']}"
+            graph.node(nid, label=label)
+            add(node["left_child"], nid, "yes")
+            add(node["right_child"], nid, "no")
+        else:
+            li, lv = _leaf_desc(node, precision)
+            nid = f"leaf{li}"
+            label = f"leaf {li}: {lv}"
+            if "leaf_count" in info and "leaf_count" in node:
+                label += f"\ncount: {node['leaf_count']}"
+            graph.node(nid, label=label)
+        if parent is not None:
+            graph.edge(parent, nid, decision)
+        return nid
+
+    add(tree)
+    return graph

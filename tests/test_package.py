@@ -450,3 +450,23 @@ def test_quantized_hist_training_quality():
         out[quant] = rank_auc(y, bst.predict(X))
     assert out[True] == pytest.approx(out[False], abs=0.01)
     assert out[True] > 0.97
+
+
+def test_create_tree_digraph():
+    """Reference plotting.py:311-381 — a graphviz Digraph with split
+    and leaf nodes for one tree."""
+    pytest.importorskip("graphviz")
+    from conftest import make_binary
+
+    X, y = make_binary(n=800, f=5, seed=61)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), 5,
+                    verbose_eval=False, keep_training_booster=True)
+    g = lgb.create_tree_digraph(
+        bst, tree_index=1,
+        show_info=["split_gain", "leaf_count", "internal_count"])
+    src = g.source
+    assert "split" in src and "leaf" in src
+    assert "gain:" in src and "count:" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(bst, tree_index=99)
