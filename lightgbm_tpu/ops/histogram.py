@@ -51,7 +51,11 @@ def build_histogram(bins: jax.Array, w: jax.Array, *, num_bins: int,
         b, wc = args
         # one-hot [chunk, F, B] contracted over rows on the MXU
         oh = jax.nn.one_hot(b, num_bins, dtype=jnp.float32)
+        # HIGHEST: default matmul precision truncates f32 operands to
+        # bf16 (shape-dependent, CPU XLA included) — this fallback is
+        # the exact-histogram oracle, so the raw g/h must not round
         h = jnp.einsum("cfb,cd->fbd", oh, wc,
+                       precision=jax.lax.Precision.HIGHEST,
                        preferred_element_type=jnp.float32)
         return acc + h, None
 

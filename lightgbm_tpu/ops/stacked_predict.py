@@ -513,8 +513,14 @@ def _kernel(codes, W, P, tgt, leaf, clsOH, Wtot: int, pred_leaf: bool):
         if pred_leaf:
             li = jnp.argmax(match, axis=2).astype(jnp.int32)
             return acc, li
-        val = jnp.einsum("ntl,tl->nt", match, leafc)
-        return acc + val @ clsc, None
+        # HIGHEST: default matmul precision truncates f32 operands to
+        # bf16 (on CPU XLA too, shape-dependent) — leaf values and the
+        # class scatter must stay exact f32
+        val = jnp.einsum("ntl,tl->nt", match, leafc,
+                         precision=jax.lax.Precision.HIGHEST)
+        acc = acc + jnp.matmul(val, clsc,
+                               precision=jax.lax.Precision.HIGHEST)
+        return acc, None
 
     acc0 = jnp.zeros((n, clsOH.shape[-1]), jnp.float32)
     acc, ys = jax.lax.scan(step, acc0, (W, P, tgt, leaf, clsOH))

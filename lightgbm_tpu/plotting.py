@@ -134,6 +134,20 @@ def plot_metric(booster, metric=None, dataset_names=None, ax=None,
     return ax
 
 
+def _tree_model(booster, tree_index):
+    """Shared renderer preamble: normalize Booster/LGBMModel, dump the
+    model, bound-check the tree, return (tree_structure, names)."""
+    if hasattr(booster, "booster_"):
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel")
+    model = booster.dump_model()
+    if tree_index >= len(model["tree_info"]):
+        raise IndexError("tree_index is out of range")
+    return (model["tree_info"][tree_index]["tree_structure"],
+            model["feature_names"])
+
+
 def _split_desc(node, names, precision):
     """Shared split-node text: feature-name fallback + threshold
     rounding used by both tree renderers."""
@@ -156,15 +170,7 @@ def plot_tree(booster, ax=None, tree_index=0, figsize=None,
     ``show_info``: extra node fields to annotate, from
     {'internal_count', 'internal_value', 'leaf_count'}."""
     plt = _check_matplotlib()
-    if hasattr(booster, "booster_"):
-        booster = booster.booster_
-    if not isinstance(booster, Booster):
-        raise TypeError("booster must be Booster or LGBMModel")
-    model = booster.dump_model()
-    if tree_index >= len(model["tree_info"]):
-        raise IndexError("tree_index is out of range")
-    tree = model["tree_info"][tree_index]["tree_structure"]
-    names = model["feature_names"]
+    tree, names = _tree_model(booster, tree_index)
 
     if ax is None:
         _, ax = plt.subplots(1, 1, figsize=figsize or (12, 8))
@@ -227,15 +233,7 @@ def create_tree_digraph(booster, tree_index=0, show_info=None,
         from graphviz import Digraph
     except ImportError:
         raise ImportError("You must install graphviz to plot tree.")
-    if hasattr(booster, "booster_"):
-        booster = booster.booster_
-    if not isinstance(booster, Booster):
-        raise TypeError("booster must be Booster or LGBMModel")
-    model = booster.dump_model()
-    if tree_index >= len(model["tree_info"]):
-        raise IndexError("tree_index is out of range")
-    tree = model["tree_info"][tree_index]["tree_structure"]
-    names = model["feature_names"]
+    tree, names = _tree_model(booster, tree_index)
     info = show_info or []
 
     graph = Digraph(name=name, comment=comment, **kwargs)

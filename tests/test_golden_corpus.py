@@ -1,0 +1,69 @@
+"""Extended golden corpus: BOTH interop directions against the real
+reference engine, across 5 configs.
+
+tests/data/golden2/* was produced by the reference engine itself
+(lib_lightgbm.so rebuilt from /root/reference, driven through its C API
+by a small harness — train from CSV, SaveModel, PredictForFile). For
+each case:
+
+  g2_<name>_model.txt        model TRAINED BY THE REFERENCE
+  g2_<name>_pred.bin         reference predictions on X
+  g2_<name>_ours_model.txt   model trained by THIS engine (frozen)
+  g2_<name>_ours_refpred.bin REFERENCE predictions on OUR model file
+
+Forward: we load the reference's model and must reproduce its
+predictions. Reverse: the reference loaded OUR model file and
+predicted; our predictions on the same frozen model must match what
+the reference computed from it. Together these pin byte-level model
+interop over binary, L2/L1 regression (leaf renewal), multiclass
+softmax, and categorical bitset splits — the corpus that caught a
+shape-dependent bf16 matmul-precision bug in the stacked predictor.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "golden2")
+
+CASES = ["binary", "regl2", "regl1", "multic", "catbin"]
+
+
+def _inputs(name):
+    X = np.fromfile(os.path.join(DATA, f"g2_{name}_X.bin"),
+                    np.float64).reshape(600, 8)
+    y = np.fromfile(os.path.join(DATA, f"g2_{name}_y.bin"), np.float32)
+    return X, y
+
+
+def _pred_shape(pred, n):
+    return pred.reshape(n, -1).squeeze()
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_forward_reference_model_predicts_identically(name):
+    X, _ = _inputs(name)
+    ref = np.fromfile(os.path.join(DATA, f"g2_{name}_pred.bin"),
+                      np.float64)
+    bst = lgb.Booster(
+        model_file=os.path.join(DATA, f"g2_{name}_model.txt"))
+    ours = np.asarray(bst.predict(X))
+    np.testing.assert_allclose(
+        ours.reshape(-1), ref.reshape(-1), atol=1e-5,
+        err_msg=f"{name}: reference-trained model predictions diverge")
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_reverse_reference_reads_our_model_identically(name):
+    X, _ = _inputs(name)
+    ref_on_ours = np.fromfile(
+        os.path.join(DATA, f"g2_{name}_ours_refpred.bin"), np.float64)
+    bst = lgb.Booster(
+        model_file=os.path.join(DATA, f"g2_{name}_ours_model.txt"))
+    ours = np.asarray(bst.predict(X))
+    np.testing.assert_allclose(
+        ours.reshape(-1), ref_on_ours.reshape(-1), atol=1e-5,
+        err_msg=f"{name}: the reference engine read our model file and "
+                f"computed different predictions")
