@@ -1,5 +1,5 @@
 """Extended golden corpus: BOTH interop directions against the real
-reference engine, across 5 configs.
+reference engine, across 7 configs.
 
 tests/data/golden2/* was produced by the reference engine itself
 (lib_lightgbm.so rebuilt from /root/reference, driven through its C API
@@ -16,7 +16,8 @@ predictions. Reverse: the reference loaded OUR model file and
 predicted; our predictions on the same frozen model must match what
 the reference computed from it. Together these pin byte-level model
 interop over binary, L2/L1 regression (leaf renewal), multiclass
-softmax, and categorical bitset splits — the corpus that caught a
+softmax, categorical bitset splits, and DART/GOSS boosting (per-tree
+shrinkage bookkeeping) — the corpus that caught a
 shape-dependent bf16 matmul-precision bug in the stacked predictor.
 """
 import os
@@ -28,7 +29,8 @@ import lightgbm_tpu as lgb
 
 DATA = os.path.join(os.path.dirname(__file__), "data", "golden2")
 
-CASES = ["binary", "regl2", "regl1", "multic", "catbin"]
+CASES = ["binary", "regl2", "regl1", "multic", "catbin",
+         "dart", "goss"]
 
 
 def _inputs(name):
