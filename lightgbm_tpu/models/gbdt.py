@@ -1055,16 +1055,20 @@ class GBDT:
         self._best_msg = [[""] * len(ms) for ms in self.valid_metrics]
         start_time = time.monotonic()
         is_finished = False
-        iter0 = self.iter_
-        for it in range(iter0, cfg.num_iterations):
+        # num_iterations counts ADDITIONAL rounds on top of a loaded
+        # input_model, like the reference's train loop (gbdt.cpp:248
+        # iterates config num_iterations times from the loaded state);
+        # the log/snapshot index is likewise the ADDITIONAL-round
+        # counter (gbdt.cpp:255-260 uses its loop-local iter + 1)
+        for add in range(cfg.num_iterations):
             is_finished = self.train_one_iter()
             if not is_finished:
-                is_finished = self._eval_and_check_early_stopping()
+                is_finished = self._eval_and_check_early_stopping(add + 1)
             log.info("%f seconds elapsed, finished iteration %d",
-                     time.monotonic() - start_time, it + 1)
-            if snapshot_freq > 0 and (it + 1) % snapshot_freq == 0:
+                     time.monotonic() - start_time, add + 1)
+            if snapshot_freq > 0 and (add + 1) % snapshot_freq == 0:
                 self.save_model_to_file(
-                    f"{output_model}.snapshot_iter_{it + 1}")
+                    f"{output_model}.snapshot_iter_{add + 1}")
             if is_finished:
                 break
         self.finish_training()
@@ -1075,8 +1079,10 @@ class GBDT:
         timing.log_report("training phase timings "
                           "(serial_tree_learner.cpp:14-41 analog)")
 
-    def _eval_and_check_early_stopping(self) -> bool:
-        best_msg = self._output_metric(self.iter_)
+    def _eval_and_check_early_stopping(self, it: int) -> bool:
+        # ``it`` counts additional rounds like the reference's iter_
+        # (reset to 0 on model load, gbdt_model_text.cpp:485)
+        best_msg = self._output_metric(it)
         if not best_msg:
             return False
         es = self.config.early_stopping_round

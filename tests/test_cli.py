@@ -189,8 +189,10 @@ output_model = {tmp_path}/model.txt
             Application([f"config={conf}", f"input_model={model}",
                          f"output_model={out2}", "num_trees=8"]).run()
             text = open(out2).read()
-            assert "Tree=7" in text      # 5 loaded + 3 new
-            assert "Tree=8" not in text
+            # reference semantics (gbdt.cpp:248): num_trees counts
+            # ADDITIONAL rounds on top of the loaded model: 5 + 8
+            assert "Tree=12" in text
+            assert "Tree=13" not in text
         finally:
             os.chdir(cwd)
 
