@@ -1086,8 +1086,10 @@ class GBDT:
         if not best_msg:
             return False
         es = self.config.early_stopping_round
+        # report in additional-round numbers so the lines match the
+        # "Iteration:N" metric output (reference iter_ semantics)
         log.info("Early stopping at iteration %d, the best iteration "
-                 "round is %d", self.iter_, self.iter_ - es)
+                 "round is %d", it, it - es)
         log.info("Output of best iteration round:\n%s", best_msg)
         self._drop_last_iterations(es)
         return True
