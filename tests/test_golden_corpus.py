@@ -1,5 +1,5 @@
 """Extended golden corpus: BOTH interop directions against the real
-reference engine, across 8 configs.
+reference engine, across 10 configs.
 
 tests/data/golden2/* was produced by the reference engine itself
 (lib_lightgbm.so rebuilt from /root/reference, driven through its C API
@@ -17,7 +17,8 @@ predicted; our predictions on the same frozen model must match what
 the reference computed from it. Together these pin byte-level model
 interop over binary, L2/L1 regression (leaf renewal), multiclass
 softmax, categorical bitset splits, and DART/GOSS boosting (per-tree
-shrinkage bookkeeping). The "contin" case goes further: OUR engine
+shrinkage bookkeeping), lambdarank with .query sidecars, and
+row-weighted training (.weight sidecar). The "contin" case goes further: OUR engine
 CONTINUED training from a reference-trained model and the reference
 engine then read the mixed-provenance file — its predictions must
 match ours. This corpus caught a shape-dependent bf16
@@ -35,7 +36,7 @@ import lightgbm_tpu as lgb
 DATA = os.path.join(os.path.dirname(__file__), "data", "golden2")
 
 CASES = ["binary", "regl2", "regl1", "multic", "catbin",
-         "dart", "goss", "contin"]
+         "dart", "goss", "contin", "rank", "wbin"]
 
 
 def _inputs(name):
