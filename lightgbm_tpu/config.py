@@ -274,6 +274,16 @@ class Config:
     # (3 channels). Costs ~1e-3 AUC-grade noise on the split gains;
     # serial tree_learner without EFB bundles only.
     tpu_quantized_hist: bool = False
+    # count-proxy histograms (int8 quantized mode only): drop the count
+    # channel from the MXU histogram dot so 2 channels x W <= 128 lanes
+    # buys 64-leaf waves — fewer full-data passes per tree (~20% faster
+    # at HIGGS scale). Per-bin counts become hessian-proportional
+    # ESTIMATES used only by the min_data_in_leaf candidate gate;
+    # per-leaf counts (leaf_count / internal_count in the model file)
+    # stay exact via partition-mask counting. -1 = auto (on when
+    # tpu_quantized_hist and the fused kernel is eligible); 0 = off;
+    # 1 = on.
+    tpu_count_proxy: int = -1
     # write an xprof/tensorboard device trace of the training loop here
     # (engine.train wraps the loop in jax.profiler.start/stop_trace)
     tpu_profile_dir: str = ""

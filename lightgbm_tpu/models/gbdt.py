@@ -239,7 +239,27 @@ class GBDT:
             log.warning("tpu_quantized_hist is not supported with "
                         "tree_learner=feature; using %s histograms",
                         "f32-grade" if cfg.tpu_use_dp else "bf16")
-        if quant:
+        # count-proxy (see config.tpu_count_proxy): int8-only, needs the
+        # fused kernel's default seams — serial/data modes, no EFB
+        # bundles, no forced splits (voting reads LOCAL count sums in
+        # its election, which proxy's global synthesis would corrupt)
+        # (categorical excluded: _categorical_tables derives right-side
+        # counts as num_data - left, which would turn the proxy's lower
+        # bounds into over-estimates)
+        proxy = (quant and mode in ("serial", "data")
+                 and not self._use_bundles
+                 and not cfg.forcedsplits_filename
+                 and not hp.has_cat
+                 and cfg.tpu_count_proxy != 0)
+        if cfg.tpu_count_proxy == 1 and not proxy:
+            log.warning("tpu_count_proxy needs tpu_quantized_hist with "
+                        "tree_learner serial/data, no EFB bundles, no "
+                        "forced splits and no categorical features; "
+                        "using exact counts")
+        if quant and proxy:
+            precision, w_cap = "int8", 64    # 2ch (count-proxy) cap 64
+            hp = hp._replace(count_lb=True)  # conservative min_data gate
+        elif quant:
             precision, w_cap = "int8", 40    # 3ch cap 42, 8-aligned 40
         elif cfg.tpu_use_dp:
             precision, w_cap = "highest", 24
@@ -257,10 +277,15 @@ class GBDT:
             num_bins=max(self.train_data.max_bin_global, 2),
             wave_size=W,
             max_depth=cfg.max_depth,
-            chunk=cfg.tpu_hist_chunk if cfg.tpu_hist_chunk > 0 else 0,
+            # int8 kernels measured fastest at 16k-row chunks (the
+            # 2-channel working set leaves the VMEM headroom for it);
+            # other tiers keep the implementation default (8192)
+            chunk=(cfg.tpu_hist_chunk if cfg.tpu_hist_chunk > 0
+                   else 16384 if quant else 0),
             hp=hp,
             precision=precision,
-            forced=self._parse_forced_splits())
+            forced=self._parse_forced_splits(),
+            count_proxy=proxy)
         self._grower_cfg = gcfg
         hist_fn = None
         if self._use_bundles:

@@ -114,7 +114,7 @@ def wave_histogram_xla(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 
 def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
                       groups, group_sz, hilo, exact_dot=False,
-                      int8=False):
+                      int8=False, count_proxy=False):
     """One grid step = one row chunk; accumulates into out_ref (VMEM).
 
     Every tensor keeps ROWS ON THE LANE AXIS — no relayouts anywhere:
@@ -145,7 +145,10 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
     lvec = ghl_ref[2:3, :]
     wl = wl_ref[...]                                    # [Wp, 1]
     mw = ((lvec == wl[:W]) & (wl[:W] >= 0.0)).astype(jnp.float32)
-    if int8:
+    if int8 and count_proxy:
+        # count-proxy: 2 channels only (see fused kernel / wave_grower)
+        w_rows = jnp.concatenate([mw * gvec, mw * hvec], axis=0)
+    elif int8:
         # quantized mode: gvec/hvec carry integer values in [-127, 127]
         # (tpu_quantized_hist, see wave_grower); int8 x int8 -> int32
         # MXU products are exact and run at 2x the bf16 rate
@@ -213,10 +216,10 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "chunk", "interpret",
-                                    "precision"))
+                                    "precision", "count_proxy"))
 def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                           chunk=2048, interpret=False, precision="highest",
-                          gh_scale=None):
+                          gh_scale=None, count_proxy=False):
     """Pallas wave histogram — same contract as wave_histogram_xla.
 
     Grid over row chunks; per chunk the kernel builds the leaf-membership
@@ -238,11 +241,14 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     W = int(wave_leaves.shape[0])
     B = num_bins
     int8 = precision == "int8"
+    if count_proxy and not int8:
+        raise NotImplementedError("count_proxy requires precision='int8'")
     hilo = precision == "highest"
-    ncol = (5 if hilo else 3) * W
+    nchan = (2 if count_proxy else 3) if int8 else 5 if hilo else 3
+    ncol = nchan * W
     if ncol > 128:
         raise NotImplementedError(
-            f"wave_size {W} needs {5 if hilo else 3}W <= 128 lanes")
+            f"wave_size {W} needs {nchan}W <= 128 lanes")
     if int8 and 127 * (n + (-n) % chunk) >= 2 ** 31:
         raise NotImplementedError(
             "int8 histogram sums could overflow int32 beyond ~16.9M "
@@ -273,7 +279,7 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     kernel = functools.partial(
         _wave_hist_kernel, F=F, B=B, W=W, groups=groups,
         group_sz=group_sz, hilo=hilo, exact_dot=interpret and not int8,
-        int8=int8)
+        int8=int8, count_proxy=count_proxy)
 
     out = pl.pallas_call(
         kernel,
@@ -307,6 +313,10 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                          out[:, :, 2] + out[:, :, 3],     # h = hi + lo
                          out[:, :, 4]], axis=2)           # count
         return out.transpose(3, 0, 1, 2)
+    if count_proxy:
+        out = out.reshape(F, B, 2, W).transpose(3, 0, 1, 2)
+        return out.astype(jnp.float32) * jnp.stack(
+            [jnp.float32(gh_scale[0]), jnp.float32(gh_scale[1])])
     out = out.reshape(F, B, 3, W).transpose(3, 0, 1, 2)
     if int8:
         out = out.astype(jnp.float32) * _qscale_vec(gh_scale)
@@ -322,19 +332,23 @@ def _qscale_vec(gh_scale):
 
 def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                    chunk=0, use_pallas=None, precision="highest",
-                   gh_scale=None):
+                   gh_scale=None, count_proxy=False):
     """Dispatch: Pallas on TPU, XLA elsewhere (or force via use_pallas).
 
     precision="int8": g/h are integer-valued (quantized) and gh_scale
     dequantizes the sums; the XLA scatter path is exact on integer
-    floats as-is, so only the Pallas kernel switches dtype."""
+    floats as-is, so only the Pallas kernel switches dtype.
+    count_proxy: the Pallas kernel returns 2 channels (g, h); the XLA
+    oracle still returns 3 exact channels — proxy callers overwrite
+    the count channel either way (wave_grower.bound_counts)."""
     if use_pallas is None:
         from ..utils.device import on_tpu
         use_pallas = on_tpu()
     if use_pallas:
         return wave_histogram_pallas(
             bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
-            chunk=chunk or 8192, precision=precision, gh_scale=gh_scale)
+            chunk=chunk or 8192, precision=precision, gh_scale=gh_scale,
+            count_proxy=count_proxy)
     out = wave_histogram_xla(
         bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
         chunk=0, precision="highest")
@@ -357,11 +371,17 @@ TBL_ROWS = 24           # padded to an int32 sublane multiple
 FUSED_MAX_WAVE = 32          # 4 channels x W <= 128 MXU lanes (bf16 h)
 FUSED_MAX_WAVE_HILO = 24     # 5 channels, kept a multiple of 8
 FUSED_MAX_WAVE_INT8 = 42     # 3 channels (int8 gq/hq/count)
+FUSED_MAX_WAVE_INT8_NC = 64  # 2 channels (count-proxy mode: the MXU dot
+                             # carries only gq/hq; per-bin counts are
+                             # synthesized downstream from the hessian
+                             # channel and EXACT per-child counts come
+                             # from the partition mask — see wave_grower)
 
 
 def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
-                  hist_ref, leaf_out_ref, *, F, B, W, groups, group_sz,
-                  hilo, exact_dot=False, int8=False, any_cat=True):
+                  hist_ref, leaf_out_ref, *maybe_cnt, F, B, W, groups,
+                  group_sz, hilo, exact_dot=False, int8=False,
+                  any_cat=True, count_proxy=False):
     """One grid step: partition one row chunk by the wave's W splits,
     then accumulate the wave's smaller-child histograms — ONE data pass.
 
@@ -390,10 +410,13 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     hessian single bf16 (2^-9 relative rounding). Counts exact always.
     """
     step = pl.program_id(0)
+    cnt_ref = maybe_cnt[0] if count_proxy else None
 
     @pl.when(step == 0)
     def _():
         hist_ref[...] = jnp.zeros_like(hist_ref)
+        if count_proxy:
+            cnt_ref[...] = jnp.zeros_like(cnt_ref)
 
     i32 = jnp.int32
     leaf = leaf_ref[...]                                # [1, Ct]
@@ -418,7 +441,19 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     # and it replaces the previous F-deep select sweep over [W, Ct]
     # (F x W VPU ops per row) with an F-contraction matmul.
     feat_c = tbl_ref[:W, TBL_FEAT:TBL_FEAT + 1]
-    if B <= 256:
+    if B <= 128:
+        # int8 gather: bin values <= 127 are exact int8, the one-hot
+        # row-select dot runs at the MXU's 2x int8 rate and accumulates
+        # exactly in int32
+        f_iota = jax.lax.broadcasted_iota(i32, (W, F), 1)
+        feat_oh8 = (f_iota == feat_c).astype(jnp.int8)      # [W, F]
+        bins_i8 = binsf_ref[...].astype(i32) \
+            .astype(jnp.int8)                               # [F, Ct]
+        cols = jax.lax.dot_general(
+            feat_oh8, bins_i8,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=i32)                      # [W, Ct]
+    elif B <= 256:
         f_iota = jax.lax.broadcasted_iota(i32, (W, F), 1)
         feat_oh = (f_iota == feat_c).astype(jnp.bfloat16)   # [W, F]
         # (Mosaic has no u8->bf16 cast; hop through i32)
@@ -437,11 +472,17 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
                              binsf_ref[f, :].astype(i32)[None, :], cols)
     # missing semantics match ops/partition.py row_goes_right; logical
     # form, not jnp.where-on-bools (Mosaic can't lower the i8->i1
-    # truncation a boolean select produces)
-    is_missing = (((miss_c == 2) & (cols == nb_c - 1))
-                  | ((miss_c == 1) & (cols == defb_c)))
-    right = ((is_missing & (dleft_c == 0))
-             | (~is_missing & (cols > bin_c)))
+    # truncation a boolean select produces). Per-slot SENTINEL bins
+    # fold the missing-type tests into the cheap [W, 1] lane: -9 never
+    # matches a real bin, so each [W, Ct] compare does double duty
+    na_sent = jnp.where(miss_c == 2, nb_c - 1, -9)
+    def_sent = jnp.where(miss_c == 1, defb_c, -9)
+    is_missing = (cols == na_sent) | (cols == def_sent)
+    gt = cols > bin_c
+    ndl = dleft_c == 0
+    # right = is_missing ? !default_left : col > threshold, in xor form
+    # (two fewer [W, Ct] ops than the and/or expansion)
+    right = gt ^ (is_missing & (gt ^ ndl))
     # categorical: the bin's bit set in the slot's left bitset -> LEFT
     # (dense_bin.hpp SplitCategorical); unseen/NaN bins go right.
     # Statically skipped when the dataset has no categorical features
@@ -459,20 +500,42 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
         # logical form (no bool select — see `right` above)
         iscat_b = iscat_c > 0
         right = (iscat_b & ~cat_left) | (~iscat_b & right)
-    moved = (leaf == parent_c) & right & (parent_c >= 0)    # [W, Ct]
-    any_moved = jnp.any(moved, axis=0, keepdims=True)       # [1, Ct]
-    dest = jnp.sum(jnp.where(moved, new_c, 0), axis=0,
-                   keepdims=True)
-    leaf_new = jnp.where(any_moved, dest, leaf)             # [1, Ct]
+    # inactive (parent -1) slots can only match CHUNK-PADDED tail rows
+    # (leaf -1; real leaf ids are never negative); their g/h/mask are
+    # zero and their leaf_out is sliced off, so no >= 0 guard is needed
+    moved = (leaf == parent_c) & right                      # [W, Ct]
+    # destination via (new_id + 1) so inactive slots (-1 -> 0) drop out
+    # of the sum and the `any` reduce is folded into one pass
+    dest1 = jnp.sum(jnp.where(moved, new_c + 1, 0), axis=0,
+                    keepdims=True)                          # [1, Ct]
+    leaf_new = jnp.where(dest1 > 0, dest1 - 1, leaf)        # [1, Ct]
     leaf_out_ref[...] = leaf_new
 
     # ---- transposed wave weight rows ----
     gvec = ghm_ref[0:1, :]
     hvec = ghm_ref[1:2, :]
     mvec = ghm_ref[2:3, :]
-    m = ((leaf_new == small_c.astype(i32))
-         & (small_c >= 0)).astype(jnp.float32)              # [W, Ct]
-    if int8:
+    # (small -1 slots likewise only match zero-weight padded tail rows)
+    m = (leaf_new == small_c).astype(jnp.float32)           # [W, Ct]
+    if count_proxy:
+        # exact per-slot right-child counts from the partition mask:
+        # the count CHANNEL is gone from the MXU dot, but the exact
+        # in-bag row count of every new (right) child falls out of
+        # `moved` for the cost of one [W, Ct] reduce — wave_grower
+        # derives the left side as parent - right and synthesizes the
+        # per-bin count estimates from the hessian channel
+        mvd = moved.astype(jnp.float32) * mvec              # [W, Ct]
+        s = jnp.sum(mvd, axis=1, keepdims=True)             # [W, 1]
+        wp_c = cnt_ref.shape[0]
+        if wp_c != W:
+            s = jnp.pad(s, ((0, wp_c - W), (0, 0)))
+        cnt_ref[...] += jnp.broadcast_to(s, cnt_ref.shape)
+    if int8 and count_proxy:
+        # 2 channels x W <= 128 lanes -> waves up to 64 leaves wide,
+        # cutting full-data passes per tree (the count channel's lane
+        # budget bought more wave width than the counts were worth)
+        w_rows = jnp.concatenate([m * gvec, m * hvec], axis=0)  # [2W, Ct]
+    elif int8:
         # quantized mode (tpu_quantized_hist): gvec/hvec hold integers
         # in [-127, 127]; int8 MXU products, exact int32 sums, 2x rate
         w_rows = jnp.concatenate(
@@ -532,14 +595,16 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk",
                                              "interpret", "precision",
-                                             "any_cat"))
+                                             "any_cat", "count_proxy"))
 def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
                                      leaf_ids, tbl, *, num_bins,
                                      chunk=2048, interpret=False,
                                      precision="highest",
-                                     gh_scale=None, any_cat=True):
+                                     gh_scale=None, any_cat=True,
+                                     count_proxy=False):
     """Partition one wave + build its smaller-child histograms in ONE
-    data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]).
+    data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]) — or, with
+    ``count_proxy``, (new_leaf_ids, hist [W, F, B, 2], cnt_right [W]).
 
     tbl: [18, W] int32 packed split table (TBL_* rows: 10 scalar
     fields + 8 categorical bitset words). g/h must be pre-masked by
@@ -549,13 +614,22 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     precision="int8": g/h are pre-quantized integer-valued floats
     (tpu_quantized_hist); sums accumulate exactly in int32 at 2x MXU
     rate and ``gh_scale`` dequantizes the output.
+
+    count_proxy (int8 only): drop the count channel from the MXU dot
+    (2 channels x W <= 128 -> waves up to 64 wide, fewer full-data
+    passes per tree). The returned ``cnt_right`` holds each slot's
+    EXACT in-bag row count moved to the new (right) child; per-bin
+    count estimates are synthesized downstream (wave_grower).
     """
     F, n = bins_t.shape
     W = int(tbl.shape[1])
     B = num_bins
     int8 = precision == "int8"
+    if count_proxy and not int8:
+        raise NotImplementedError("count_proxy requires precision='int8'")
     hilo = precision == "highest"
-    cap = (FUSED_MAX_WAVE_INT8 if int8
+    cap = (FUSED_MAX_WAVE_INT8_NC if int8 and count_proxy
+           else FUSED_MAX_WAVE_INT8 if int8
            else FUSED_MAX_WAVE_HILO if hilo else FUSED_MAX_WAVE)
     if W > cap:
         raise NotImplementedError(f"fused wave needs W <= {cap}")
@@ -563,7 +637,7 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
         raise NotImplementedError(
             "int8 histogram sums could overflow int32 beyond ~16.9M "
             "rows; disable tpu_quantized_hist")
-    nchan = 3 if int8 else 5 if hilo else 4
+    nchan = (2 if count_proxy else 3) if int8 else 5 if hilo else 4
     Bp = _round_up(B, 8)
     group_sz = max(1, 128 // Bp)
     gb = group_sz * Bp
@@ -592,9 +666,25 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     kernel = functools.partial(
         _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz,
         hilo=hilo, exact_dot=interpret and not int8, int8=int8,
-        any_cat=any_cat)
+        any_cat=any_cat, count_proxy=count_proxy)
 
-    hist, leaf_out = pl.pallas_call(
+    wp = _round_up(W, 8)
+    out_specs = [
+        pl.BlockSpec((groups, gb_pad, 128), lambda i: (0, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, chunk), lambda i: (0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((groups, gb_pad, 128),
+                             jnp.int32 if int8 else jnp.float32),
+        jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+    ]
+    if count_proxy:
+        out_specs.append(pl.BlockSpec((wp, 128), lambda i: (0, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((wp, 128), jnp.float32))
+    outs = pl.pallas_call(
         kernel,
         grid=(n_pad // chunk,),
         in_specs=[
@@ -607,28 +697,26 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
             pl.BlockSpec((1, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=(
-            pl.BlockSpec((groups, gb_pad, 128), lambda i: (0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, chunk), lambda i: (0, i),
-                         memory_space=pltpu.VMEM),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((groups, gb_pad, 128),
-                                 jnp.int32 if int8 else jnp.float32),
-            jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
-        ),
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(tblT, bins_t, ghm, leaf2d)
+    hist, leaf_out = outs[0], outs[1]
 
-    # [groups, gb_pad, 128] -> [F, B, nchan*W] -> [W, F, B, 3].
+    # [groups, gb_pad, 128] -> [F, B, nchan*W] -> [W, F, B, nchan'].
     # channel rows were [c*W + k]: reshape (nchan, W) then combine
     # (feature rows sit at the aligned Bp stride; slice back to B)
     hist = hist[:, :gb, :nchan * W].reshape(
         groups * group_sz, Bp, nchan * W)[:F, :B]
     hist = hist.reshape(F, B, nchan, W)
+    if count_proxy:
+        hist = hist.astype(jnp.float32).transpose(0, 1, 3, 2) \
+            * jnp.stack([jnp.float32(gh_scale[0]),
+                         jnp.float32(gh_scale[1])])        # [F,B,W,2]
+        return (leaf_out[0, :n], hist.transpose(2, 0, 1, 3),
+                outs[2][:W, 0])
     if int8:
         hist = hist.astype(jnp.float32).transpose(0, 1, 3, 2) \
             * _qscale_vec(gh_scale)                        # [F,B,W,3]

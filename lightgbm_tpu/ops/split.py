@@ -58,6 +58,14 @@ class SplitParams(NamedTuple):
     # static trace-time gate: False compiles the categorical search out
     # entirely (set per-dataset; numerical-only runs pay nothing)
     has_cat: bool = True
+    # count-proxy mode (tpu_count_proxy): the histogram count channel
+    # carries per-bin LOWER BOUNDS, not exact counts. Both sides of the
+    # min_data_in_leaf gate must then come from prefix/suffix sums of
+    # the channel itself (a sum of lower bounds is a lower bound) —
+    # deriving one side as num_data - other_side would turn an
+    # under-estimate into an over-estimate and let min_data violations
+    # through. Conservative: never under-prunes, may over-prune.
+    count_lb: bool = False
 
 
 class FeatureMeta(NamedTuple):
@@ -196,7 +204,9 @@ def _candidate_tables(hist: jax.Array, sum_g, sum_h, num_data,
     l_c1 = cum[:, :, 2]
     r_g1 = sum_g - l_g1
     r_h1 = sum_h2 - l_h1
-    r_c1 = num_data - l_c1
+    # count_lb: the right-side count must be the SUFFIX sum of the
+    # (lower-bound) channel, not num_data - prefix (see SplitParams)
+    r_c1 = (tot[:, None, 2] - l_c1) if hp.count_lb else num_data - l_c1
     valid1 = (two_scan[:, None]
               & (bidx <= nb_c - 2)
               & ~(skip_db[:, None] & (bidx == db[:, None])))
@@ -207,7 +217,7 @@ def _candidate_tables(hist: jax.Array, sum_g, sum_h, num_data,
     r_c2 = tot[:, None, 2] - cum[:, :, 2]
     l_g2 = sum_g - r_g2
     l_h2 = sum_h2 - r_h2
-    l_c2 = num_data - r_c2
+    l_c2 = cum[:, :, 2] if hp.count_lb else num_data - r_c2
     max_t2 = jnp.where(use_na, nb - 3, nb - 2)[:, None]  # dir=-1 can't emit nb-2
     valid2 = ((bidx <= max_t2)
               & (bidx >= 0)

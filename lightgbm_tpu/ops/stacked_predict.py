@@ -50,7 +50,10 @@ _ZERO_EPS = 1e-35
 MAX_FEATURE_WIDTH = 1024
 TREE_CHUNK = 16    # trees per scan/grid step (TC=16 measured ~10%
                    # faster than 8 at the 500-tree bench shape; wide
-                   # models drop to 8 so the W block stays in VMEM)
+                   # models drop TC until the kernel blocks fit VMEM)
+# fused-kernel working-set budget: stay under the 100 MB
+# vmem_limit_bytes with headroom for Mosaic's own temporaries
+_PALLAS_VMEM_BUDGET = 72 * 1024 * 1024
 
 
 class StackedModel:
@@ -163,7 +166,14 @@ class StackedModel:
                 e = self._edges[f]
                 if e is None or e.size == 0:
                     continue
-                ef = e.astype(np.float32)
+                # clip into f32 range BEFORE the cast: thresholds near
+                # ±DBL_MAX would otherwise overflow to ±inf with a
+                # RuntimeWarning. The clipped edge keeps the compare
+                # semantics: any finite f32 x <= f32max < huge-t (left
+                # stays left), and the bump below handles the negative
+                # side exactly like any other not-f32-representable edge
+                f32i = np.finfo(np.float32)
+                ef = e.clip(f32i.min, f32i.max).astype(np.float32)
                 bump = ef.astype(np.float64) > e
                 ef[bump] = np.nextafter(ef[bump], -np.inf)
                 E[f, :e.size] = ef
@@ -257,7 +267,7 @@ class StackedModel:
         return codes
 
     def _stack_range(self, key, first: int, ntree: int, Sp: int,
-                     Lp: int, tgt_dtype):
+                     Lp: int, tgt_dtype, TC: int):
         """Shared stacker for the scan (Sp=S, Lp=L) and Pallas
         (MXU-tile-padded) layouts: slice the tree range, pad to a TC
         multiple, and shape [steps, ...] chunk stacks."""
@@ -268,7 +278,7 @@ class StackedModel:
         # would otherwise pin one device copy of W/P per tree range
         while len(self._dev_cache) >= 4:
             self._dev_cache.pop(next(iter(self._dev_cache)))
-        TC = min(self._tree_chunk(), max(ntree - first, 1))
+        TC = min(TC, max(ntree - first, 1))
         nt = ntree - first
         steps = -(-nt // TC)
         pad = steps * TC - nt
@@ -312,13 +322,38 @@ class StackedModel:
         return out
 
     def _tree_chunk(self) -> int:
-        """Trees per step: halved for wide models so the Pallas W block
-        (Wtot x TC*Sp int8, double-buffered) stays within VMEM."""
+        """Trees per scan step (XLA path): halved for wide models so the
+        intermediate C matrix stays reasonable."""
         return TREE_CHUNK if self._Wtot <= 4096 else TREE_CHUNK // 2
+
+    def _pallas_tc(self, row_tile: int = 2048) -> Optional[int]:
+        """Trees per grid step for the fused forest kernel, sized from
+        the kernel's ACTUAL VMEM blocks (not just Wtot): the
+        double-buffered W ([Wtot, TC*Sp] int8) and P ([TC, Sp, Lp] int8)
+        inputs plus the in-kernel C/one-hot temporaries all scale with
+        TC and the 128-padded S/L, so a large-num_leaves model can blow
+        the budget at a modest Wtot. Returns None when even TC=1 does
+        not fit — predict() then routes to the XLA scan path instead of
+        tripping a Mosaic compile error on device."""
+        Sp = -(-self._S // 128) * 128
+        Lp = -(-self._L // 128) * 128
+        tc = TREE_CHUNK
+        while tc >= 1:
+            est = (2 * self._Wtot * tc * Sp      # W blocks (dbl-buffered)
+                   + 2 * tc * Sp * Lp            # P blocks (dbl-buffered)
+                   + row_tile * tc * Sp * 4      # C (int32)
+                   + row_tile * tc * Sp          # C8
+                   + row_tile * self._Wtot       # one-hot tile
+                   + row_tile * Lp * 4)          # per-tree E
+            if est <= _PALLAS_VMEM_BUDGET:
+                return tc
+            tc //= 2
+        return None
 
     def _device_arrays(self, first: int, ntree: int):
         return self._stack_range((first, ntree), first, ntree,
-                                 self._S, self._L, np.float32)
+                                 self._S, self._L, np.float32,
+                                 self._tree_chunk())
 
     def predict(self, X: np.ndarray, first: int = 0,
                 ntree: Optional[int] = None,
@@ -334,28 +369,33 @@ class StackedModel:
         # Probe a small sample first so ineligible inputs (true f64
         # data) don't pay a full-matrix round-trip scan.
         dev_bin = self._dev_bin_ok and X.shape[1] >= Fm
-        if dev_bin:
-            probe = X[:64, :Fm]
-            dev_bin = _f32_exact(probe, probe.astype(np.float32))
         rows = None
-        if dev_bin:
-            Xf = X[:, :Fm].astype(np.float32)
-            dev_bin = _f32_exact(X[:, :Fm], Xf)
-            rows = Xf if dev_bin else None
+        # overflow in these casts is EXPECTED for not-f32-exact data
+        # (values beyond f32 range become inf, _f32_exact rejects them
+        # and the host binning path runs) — don't warn about it
+        with np.errstate(over="ignore"):
+            if dev_bin:
+                probe = X[:64, :Fm]
+                dev_bin = _f32_exact(probe, probe.astype(np.float32))
+            if dev_bin:
+                Xf = X[:, :Fm].astype(np.float32)
+                dev_bin = _f32_exact(X[:, :Fm], Xf)
+                rows = Xf if dev_bin else None
         if rows is None:
             rows = self._bin_rows(X)
         N = X.shape[0]
         from ..utils.device import on_tpu
         forest = (use_pallas if use_pallas is not None else on_tpu())
-        # VMEM guard: the kernel's one-hot tile and W block scale with
-        # the total feature width (W block alone is Wtot x TC*Sp int8,
-        # double-buffered). Mid-width models halve TC (_tree_chunk);
-        # truly wide ones use the XLA scan path instead of crashing
-        # the fused kernel.
-        forest = forest and self._Wtot <= 8192
+        # VMEM guard from the kernel's ACTUAL block bytes (W, P, C,
+        # one-hot all scale with TC x padded S/L, not just Wtot):
+        # _pallas_tc halves the tree chunk until the blocks fit and
+        # returns None for models that cannot fit at all — those use
+        # the XLA scan path instead of crashing the fused kernel.
+        tc = self._pallas_tc() if forest else None
+        forest = forest and tc is not None
         if forest and not pred_leaf:
             # fused forest kernel: the whole ensemble in ONE dispatch
-            dev = self._device_arrays_pallas(first, ntree)
+            dev = self._device_arrays_pallas(first, ntree, tc)
             offs = tuple(int(o) for o in self._offsets)
             if dev_bin:
                 acc = forest_predict_from_x(
@@ -399,14 +439,14 @@ class StackedModel:
             axis=0)[:N].T.astype(np.float64)
 
 
-    def _device_arrays_pallas(self, first: int, ntree: int):
+    def _device_arrays_pallas(self, first: int, ntree: int, tc: int):
         """Kernel-shaped stacks: per-tree axes padded to MXU tiles
         (S -> Sp multiple of 128 so per-tree lane slices of C are
         aligned; L -> Lp for the second dot's output lanes)."""
         Sp = -(-self._S // 128) * 128
         Lp = -(-self._L // 128) * 128
-        return self._stack_range(("pallas", first, ntree), first,
-                                 ntree, Sp, Lp, np.int32)
+        return self._stack_range(("pallas", first, ntree, tc), first,
+                                 ntree, Sp, Lp, np.int32, tc)
 
 
 class _FallbackError(Exception):

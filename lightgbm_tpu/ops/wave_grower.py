@@ -66,6 +66,14 @@ class WaveGrowerConfig(NamedTuple):
     # ForceSplits): BFS-ordered ((parent_leaf, inner_feature, bin), ...)
     # applied as a fixed prefix before gain-driven growth
     forced: tuple = ()
+    # count-proxy (int8 only): drop the count channel from the MXU
+    # histogram dot so 2 channels x W <= 128 lanes buys waves up to 64
+    # leaves wide (fewer full-data passes per tree). Per-bin counts are
+    # synthesized as hessian-proportional estimates (they only gate
+    # min_data_in_leaf during candidate evaluation); per-LEAF counts
+    # stay EXACT — each wave's kernel counts the rows it moved, so
+    # leaf_count/internal_count in the model match the exact path.
+    count_proxy: bool = False
 
 
 class _State(NamedTuple):
@@ -120,7 +128,8 @@ def _store_batch(table, idx, vals, active):
 
 def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                      hist_fn=None, split_fn=None, partition_fn=None,
-                     reduce_fn=None, hist_reduce_fn=None, jit=True):
+                     reduce_fn=None, hist_reduce_fn=None,
+                     max_reduce_fn=None, jit=True):
     """Build ``grow(bins_t, grad, hess, sample_mask, feature_mask)``.
 
     bins_t is FEATURE-MAJOR [F, N] (see ops/hist_wave.py).
@@ -154,6 +163,16 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     # learners inject their own hist/partition seams)
     default_seams = (hist_fn is None and partition_fn is None)
     quant = cfg.precision == "int8"
+    proxy = bool(cfg.count_proxy)
+    if proxy and not quant:
+        raise ValueError("count_proxy requires precision='int8' "
+                         "(tpu_quantized_hist)")
+    if proxy and cfg.forced:
+        raise ValueError("count_proxy does not compose with forced "
+                         "splits; disable tpu_count_proxy")
+    if proxy and (hist_fn is not None or partition_fn is not None):
+        raise ValueError("count_proxy does not compose with injected "
+                         "histogram/partition seams")
     if quant and hist_fn is not None:
         # an injected histogram seam must understand quantized g/h —
         # silently dropping gh_scale would produce garbage histograms
@@ -166,8 +185,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     use_fused = cfg.fused
     if use_fused is None:
         from .hist_wave import (FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO,
-                                FUSED_MAX_WAVE_INT8)
-        fused_cap = (FUSED_MAX_WAVE_INT8 if quant
+                                FUSED_MAX_WAVE_INT8,
+                                FUSED_MAX_WAVE_INT8_NC)
+        fused_cap = (FUSED_MAX_WAVE_INT8_NC if quant and proxy
+                     else FUSED_MAX_WAVE_INT8 if quant
                      else FUSED_MAX_WAVE_HILO
                      if cfg.precision == "highest" else FUSED_MAX_WAVE)
         bundled = jnp.ndim(meta.bundle) != 0
@@ -207,10 +228,31 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         def hist_reduce_fn(h):
             return h
 
+    if max_reduce_fn is None:
+        def max_reduce_fn(x):
+            return x
+
     def depth_ok(depth):
         if cfg.max_depth > 0:
             return depth < cfg.max_depth
         return jnp.ones_like(depth, dtype=bool)
+
+    def bound_counts(h2, gh_scale):
+        """count-proxy: fill the count channel with per-bin LOWER
+        BOUNDS derived from the quantized g/h sums themselves —
+        |g_q| <= 127 and h_q <= 127 per row, so
+        count_bin >= max(|sum g_q|, sum h_q) / 127. Bounds are LOCAL
+        per bin (valid under prefix/suffix summation and histogram
+        subtraction is never applied to them — callers recompute the
+        channel from each child's own g/h). With hp.count_lb the
+        min_data_in_leaf gate consumes these conservatively: it can
+        over-prune but never admits a split the exact gate would
+        reject. Per-LEAF totals stay exact via partition-mask counts."""
+        h2 = h2[..., :2]
+        sg, sh = gh_scale
+        lb = jnp.maximum(jnp.abs(h2[..., 0]) / jnp.float32(sg),
+                         h2[..., 1] / jnp.float32(sh)) / 127.0
+        return jnp.concatenate([h2, lb[..., None]], axis=-1)
 
     def grow(bins_t, grad, hess, sample_mask, feature_mask):
         """Grow one tree.
@@ -236,8 +278,15 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             kbits = jax.lax.bitcast_convert_type(
                 jnp.sum(grad).astype(f32), jnp.int32)
             qkey = jax.random.fold_in(jax.random.PRNGKey(1729), kbits)
-            sg_s = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-30) / 127.0
-            sh_s = jnp.maximum(jnp.max(hess), 1e-30) / 127.0
+            # GLOBAL quantization scales (max_reduce_fn = pmax in data
+            # mode): shard-local scales would make the dequantized psum
+            # sums correct but leave count-proxy bounds computed on the
+            # GLOBAL histogram invalid (divided by a local scale) and
+            # shard-divergent — every shard must see one (sg, sh)
+            sg_s = jnp.maximum(max_reduce_fn(jnp.max(jnp.abs(grad))),
+                               1e-30) / 127.0
+            sh_s = jnp.maximum(max_reduce_fn(jnp.max(hess)),
+                               1e-30) / 127.0
             u = jax.random.uniform(qkey, (2, n), dtype=f32)
             gq = jnp.clip(jnp.floor(grad / sg_s + u[0]), -127.0, 127.0)
             hq = jnp.clip(jnp.floor(hess / sh_s + u[1]), 0.0, 127.0)
@@ -263,8 +312,19 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         root_wl = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.full(W - 1, -1, jnp.int32)])
         leaf0 = jnp.zeros(n, jnp.int32)
-        local_root = call_hist(bins_t, bag_mask_ids(leaf0),
-                               root_wl)                  # [W, F, B, 3]
+        if use_fused and proxy:
+            # proxy root: the partition-free wave kernel in 2-channel
+            # mode (wave_histogram_pallas count_proxy) — no partition
+            # logic to pay for on an unsplit tree
+            from .hist_wave import wave_histogram_pallas
+            local_root = wave_histogram_pallas(
+                bins_t, hg, hh, bag_mask_ids(leaf0), root_wl,
+                num_bins=B, chunk=cfg.chunk or 8192,
+                interpret=fused_interpret, precision=cfg.precision,
+                gh_scale=gh_scale, count_proxy=True)
+        else:
+            local_root = call_hist(bins_t, bag_mask_ids(leaf0),
+                                   root_wl)              # [W, F, B, 3]
         root_hist = hist_reduce_fn(local_root)
         F_h = root_hist.shape[1]
         if quant:
@@ -281,6 +341,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             root_g = reduce_fn(jnp.sum(grad))
             root_h = reduce_fn(jnp.sum(hess))
         root_c = reduce_fn(jnp.sum(sample_mask))
+        if proxy:
+            root_hist = bound_counts(root_hist, gh_scale)
         root_split = split_fn(
             root_hist[:1], root_g[None], root_h[None], root_c[None],
             feature_mask, depth_ok(jnp.zeros(1, jnp.int32)))
@@ -382,13 +444,16 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     meta.default_bin[safe_feat],
                     meta.num_bin[safe_feat], small_ids,
                     iscat.astype(jnp.int32)]), catw.T])      # [18, W]
-                leaf_ids, hist_small = fused_partition_histogram_pallas(
+                fused_out = fused_partition_histogram_pallas(
                     bins_t, hg, hh, sample_mask,
                     state.leaf_ids, tbl, num_bins=B,
                     chunk=cfg.chunk or 8192, interpret=fused_interpret,
                     precision=cfg.precision, gh_scale=gh_scale,
-                    any_cat=bool(hp.has_cat))
+                    any_cat=bool(hp.has_cat), count_proxy=proxy)
+                leaf_ids, hist_small = fused_out[0], fused_out[1]
                 hist_small = hist_reduce_fn(hist_small)
+                if proxy:
+                    cnt_r = reduce_fn(fused_out[2])
                 # out-of-bag rows partition too; their g/h are pre-masked
                 # and the count channel rides on sample_mask
             else:
@@ -398,8 +463,27 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 hist_small = hist_reduce_fn(
                     call_hist(bins_t, bag_mask_ids(leaf_ids),
                               small_ids))
+                if proxy:
+                    # exact in-bag right-child counts (XLA fallback for
+                    # the Pallas kernel's partition-mask counting)
+                    cnt_r = reduce_fn(jnp.sum(
+                        ((leaf_ids[None, :] == new_ids[:, None])
+                         & in_bag[None, :]).astype(jnp.float32),
+                        axis=1))
+            if proxy:
+                parent_cnt = state.leaf_count[wl]
+                lcnt_x = parent_cnt - cnt_r          # exact (partition)
+                rcnt_x = cnt_r
+                hist_small = bound_counts(hist_small, gh_scale)
+            else:
+                lcnt_x, rcnt_x = lcnt, rcnt
             parent_hist = state.hist[wl]                 # [W, F, B, 3]
             hist_large = parent_hist - hist_small
+            if proxy:
+                # the count channel holds lower bounds, which do NOT
+                # survive subtraction — recompute from the large
+                # child's own (exact) g/h sums
+                hist_large = bound_counts(hist_large, gh_scale)
             ls4 = left_smaller[:, None, None, None]
             hist_left = jnp.where(ls4, hist_small, hist_large)
             hist_right = jnp.where(ls4, hist_large, hist_small)
@@ -441,7 +525,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 return arr.at[new_s].set(rvals, mode="drop")
 
             leaf_output = upd(state.leaf_output, lo, ro)
-            leaf_count = upd(state.leaf_count, lcnt, rcnt)
+            # proxy mode: lcnt_x/rcnt_x are the partition-mask EXACT
+            # counts, so per-leaf bookkeeping (and the model file's
+            # leaf_count/internal_count) matches the exact path
+            leaf_count = upd(state.leaf_count, lcnt_x, rcnt_x)
             leaf_sum_g = upd(state.leaf_sum_g, lg, rg)
             leaf_sum_h = upd(state.leaf_sum_h, lh, rh)
             leaf_depth = upd(state.leaf_depth, child_depth, child_depth)
@@ -450,7 +537,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             hists2 = jnp.concatenate([hist_left, hist_right], axis=0)
             sg2 = jnp.concatenate([lg, rg])
             sh2 = jnp.concatenate([lh, rh])
-            nd2 = jnp.concatenate([lcnt, rcnt])
+            nd2 = jnp.concatenate([lcnt_x, rcnt_x])
             can2 = jnp.concatenate([active & depth_ok(child_depth)] * 2)
             res = split_fn(hists2, sg2, sh2, nd2, feature_mask, can2)
             gain2 = jnp.where(jnp.isfinite(res.gain), res.gain,

@@ -138,12 +138,20 @@ def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     def reduce_fn(x):
         return _psum_seam(x)
 
+    def max_reduce_fn(x):
+        # global int8 quantization scales: every shard must quantize
+        # with the same (sg, sh) or the count-proxy bounds computed on
+        # the psummed histogram would be scale-inconsistent and
+        # shard-divergent (and same-seed parity with serial improves)
+        return jax.lax.pmax(x, AXIS)
+
     # hist_fn (e.g. the EFB bundle-expansion seam) composes: each shard
     # histograms its own rows through it, then the expanded [W, F, B, 3]
     # rides the psum exactly like the default seam's output
     grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
                             hist_reduce_fn=reduce_fn,
-                            reduce_fn=reduce_fn, jit=False)
+                            reduce_fn=reduce_fn,
+                            max_reduce_fn=max_reduce_fn, jit=False)
     sharded = jax.shard_map(
         grow, mesh=mesh,
         in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P(None)),

@@ -211,3 +211,37 @@ class TestCollectiveInjection:
         a = proper.predict_raw(X[:100])
         b = broken.predict_raw(X[:100])
         assert np.abs(a - b).max() > 1e-6
+
+
+class TestDataParallelQuantized:
+    """int8 quantized histograms + count-proxy under the data-parallel
+    learner: global pmax quantization scales keep the proxy's count
+    bounds valid on the psummed histogram and identical on every shard
+    (shard-local scales would silently diverge the replicated tree)."""
+
+    def test_quant_proxy_trains_and_counts_exact(self):
+        X, y = make_binary(1282)
+        g = fit_gbdt(X, y, {"objective": "binary", "metric": "auc",
+                            "tree_learner": "data",
+                            "tpu_quantized_hist": True}, num_round=12)
+        assert g._learner_mode == "data"
+        assert g._grower_cfg.count_proxy
+        assert _auc(g) > 0.97
+        # per-leaf counts are partition-mask exact: recount from the
+        # training-data leaf assignments of the last tree
+        g._ensure_host_trees()
+        rec = g.records[-1]
+        nl = int(np.asarray(rec.num_leaves))
+        leaves = g.models[-1].predict_leaf_index(X)
+        recount = np.bincount(leaves, minlength=nl)[:nl]
+        np.testing.assert_array_equal(
+            np.asarray(rec.leaf_count)[:nl], recount)
+
+    def test_quant_exact_counts_mode(self):
+        X, y = make_binary(1282)
+        g = fit_gbdt(X, y, {"objective": "binary", "metric": "auc",
+                            "tree_learner": "data",
+                            "tpu_quantized_hist": True,
+                            "tpu_count_proxy": 0}, num_round=12)
+        assert not g._grower_cfg.count_proxy
+        assert _auc(g) > 0.97

@@ -187,3 +187,50 @@ def test_forest_pallas_multiclass_and_devbin():
     assert sm._dev_bin_ok and sp._f32_exact(Xt, Xt.astype(np.float32))
     out = sm.predict(Xt, use_pallas=True)   # device-binned codes path
     np.testing.assert_allclose(out, _host_raw(g, Xt), atol=1e-5)
+
+
+def test_huge_threshold_edges_warning_free():
+    """Thresholds near +-DBL_MAX must not overflow the f32 edge cast
+    (clip-then-cast) and device/host paths must agree on values around
+    the huge split point."""
+    import warnings
+    r = np.random.default_rng(77)
+    X = r.normal(size=(1200, 3))
+    X[:400, 0] = 1e300          # forces a split threshold ~5e299
+    X[400:800, 0] = -1e300
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float32)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
+                 num_round=8)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")      # any RuntimeWarning fails
+        sm = _stacked(g)
+        Xt = r.normal(size=(300, 3))
+        Xt[::3, 0] = 1e300
+        Xt[1::3, 0] = -1e300
+        got = sm.predict(Xt)
+    np.testing.assert_allclose(got, _host_raw(g, Xt), atol=1e-5)
+
+
+def test_pallas_vmem_guard_scales_tree_chunk():
+    """_pallas_tc sizes the fused kernel's tree chunk from the ACTUAL
+    block bytes: bench-shaped models keep TC=16, a num_leaves=1024 x
+    Wtot=8192 model (which passes a naive Wtot-only gate but needs
+    ~134 MB at TC=8) drops to a TC that fits, and an absurdly wide
+    model returns None (scan-path fallback instead of a Mosaic OOM)."""
+    from lightgbm_tpu.ops.stacked_predict import (StackedModel,
+                                                  _PALLAS_VMEM_BUDGET)
+
+    def shape(S, L, Wtot):
+        sm = StackedModel.__new__(StackedModel)
+        sm._S, sm._L, sm._Wtot = S, L, Wtot
+        return sm
+
+    assert shape(254, 255, 2016)._pallas_tc() == 16     # bench shape
+    tc = shape(1023, 1024, 8192)._pallas_tc()           # ADVICE case
+    assert tc is not None and tc <= 2
+    Sp = Lp = 1024
+    est = (2 * 8192 * tc * Sp + 2 * tc * Sp * Lp
+           + 2048 * tc * Sp * 4 + 2048 * tc * Sp
+           + 2048 * 8192 + 2048 * Lp * 4)
+    assert est <= _PALLAS_VMEM_BUDGET
+    assert shape(1023, 1024, 120_000)._pallas_tc() is None

@@ -382,3 +382,145 @@ class TestWideBins:
             jnp.asarray(dleft), jnp.asarray(wl >= 0), meta)
         np.testing.assert_array_equal(np.asarray(leaf_f),
                                       np.asarray(leaf_u))
+
+
+class TestCountProxy:
+    """count-proxy int8 mode: the MXU dot carries only g/h (2 channels,
+    waves up to 64); per-bin counts are hessian-proportional estimates
+    and per-leaf counts stay exact via partition-mask counting."""
+
+    def _qproblem(self, n=3000, F=5, seed=3):
+        r = np.random.default_rng(seed)
+        bins_t = r.integers(0, 64, (F, n), dtype=np.uint8)
+        gq = r.integers(-127, 128, n).astype(np.float32)
+        hq = r.integers(0, 128, n).astype(np.float32)
+        leaf = r.integers(0, 5, n).astype(np.int32)
+        mask = (r.random(n) < 0.8).astype(np.float32)
+        return bins_t, gq, hq, leaf, mask
+
+    def test_fused_proxy_kernel_matches_xla_gh_and_counts(self):
+        from lightgbm_tpu.ops.hist_wave import (
+            fused_partition_histogram_pallas, wave_histogram_xla)
+        from lightgbm_tpu.ops.wave_grower import apply_wave_splits
+        from lightgbm_tpu.ops.split import FeatureMeta
+        bins_t, gq, hq, leaf, mask = self._qproblem()
+        F = bins_t.shape[0]
+        meta_np = FeatureMeta(
+            num_bin=np.full(F, 64, np.int32),
+            missing_type=np.zeros(F, np.int32),
+            default_bin=np.zeros(F, np.int32),
+            monotone=np.zeros(F, np.int32),
+            penalty=np.ones(F, np.float32))
+        meta = FeatureMeta(*[jnp.asarray(x) for x in meta_np])
+        W = 16
+        wl = np.full(W, -1, np.int32); wl[:5] = np.arange(5)
+        new_ids = np.full(W, -1, np.int32)
+        new_ids[:5] = np.arange(5, 10)
+        r = np.random.default_rng(12)
+        feat = r.integers(0, F, W).astype(np.int32)
+        tbin = r.integers(0, 60, W).astype(np.int32)
+        dleft = np.zeros(W, bool)
+        gm, hm = gq * mask, hq * mask
+        tbl = jnp.stack([jnp.asarray(x) for x in [
+            wl, new_ids, feat, tbin, dleft.astype(np.int32),
+            meta_np.missing_type[feat], meta_np.default_bin[feat],
+            meta_np.num_bin[feat], new_ids,
+            np.zeros(W, np.int32)]])
+        leaf0 = np.where(mask > 0, leaf, 0).astype(np.int32)
+        sg, sh = 0.125, 2.0
+        leaf_f, hist_f, cnt_r = fused_partition_histogram_pallas(
+            jnp.asarray(bins_t), jnp.asarray(gm), jnp.asarray(hm),
+            jnp.asarray(mask), jnp.asarray(leaf0), tbl,
+            num_bins=64, chunk=256, interpret=True,
+            precision="int8", gh_scale=(sg, sh), count_proxy=True)
+        assert hist_f.shape[-1] == 2
+        leaf_u = apply_wave_splits(
+            jnp.asarray(bins_t), jnp.asarray(leaf0), jnp.asarray(wl),
+            jnp.asarray(new_ids), jnp.asarray(feat), jnp.asarray(tbin),
+            jnp.asarray(dleft), jnp.asarray(wl >= 0), meta)
+        np.testing.assert_array_equal(np.asarray(leaf_f),
+                                      np.asarray(leaf_u))
+        bag_leaf = jnp.where(jnp.asarray(mask) > 0, leaf_u, -1)
+        hist_u = np.asarray(wave_histogram_xla(
+            jnp.asarray(bins_t), jnp.asarray(gm), jnp.asarray(hm),
+            bag_leaf, jnp.asarray(new_ids), num_bins=64))
+        np.testing.assert_allclose(np.asarray(hist_f[..., 0]),
+                                   hist_u[..., 0] * sg, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(hist_f[..., 1]),
+                                   hist_u[..., 1] * sh, rtol=1e-6)
+        # exact right-child counts = in-bag rows that landed on new ids
+        lu = np.asarray(leaf_u)
+        want = np.array([((lu == ni) & (mask > 0)).sum() if ni >= 0
+                         else 0 for ni in new_ids], np.float32)
+        np.testing.assert_array_equal(np.asarray(cnt_r), want)
+
+    def _grow(self, count_proxy, W, n=4000, F=6, fused=True):
+        from lightgbm_tpu.ops.split import FeatureMeta, SplitParams
+        from lightgbm_tpu.ops.wave_grower import (WaveGrowerConfig,
+                                                  make_wave_grower)
+        r = np.random.default_rng(9)
+        bins = r.integers(0, 64, (n, F)).astype(np.uint8)
+        x = bins[:, 0].astype(np.float32) / 64.0
+        y = ((x + 0.3 * (bins[:, 1] > 40) + 0.1 * r.normal(size=n)) > 0.6)
+        p = np.full(n, 0.5, np.float32)
+        grad = (p - y).astype(np.float32)
+        hess = (p * (1 - p)).astype(np.float32)
+        # integer-quantize g/h exactly like the grower's quant step
+        # does not matter here: feed pre-quantized integer g/h so the
+        # proxy and exact paths see identical inputs
+        gq = np.round(grad * 127).astype(np.float32)
+        hq = np.maximum(np.round(hess * 127), 1).astype(np.float32)
+        meta = FeatureMeta(
+            num_bin=np.full(F, 64, np.int32),
+            missing_type=np.zeros(F, np.int32),
+            default_bin=np.zeros(F, np.int32),
+            monotone=np.zeros(F, np.int32),
+            penalty=np.ones(F, np.float32))
+        hp = SplitParams(min_data_in_leaf=0, min_sum_hessian_in_leaf=0.0,
+                         count_lb=count_proxy)
+        cfg = WaveGrowerConfig(
+            num_leaves=31, num_bins=64, wave_size=W, hp=hp,
+            precision="int8", fused=fused, chunk=512,
+            count_proxy=count_proxy)
+        grow = make_wave_grower(cfg, meta)
+        mask = np.ones(n, np.float32)
+        rec, leaf_ids = grow(jnp.asarray(bins.T.copy()),
+                             jnp.asarray(gq), jnp.asarray(hq),
+                             jnp.asarray(mask),
+                             jnp.ones(F, bool))
+        return rec, np.asarray(leaf_ids)
+
+    def test_proxy_grower_matches_exact_when_gates_idle(self):
+        """With min_data_in_leaf=1 the count gate never binds, so the
+        proxy grower must build the IDENTICAL tree to the exact int8
+        grower — per-bin counts only ever feed that gate."""
+        rec_e, leaf_e = self._grow(count_proxy=False, W=8)
+        rec_p, leaf_p = self._grow(count_proxy=True, W=8)
+        assert int(rec_p.num_leaves) == int(rec_e.num_leaves)
+        np.testing.assert_array_equal(leaf_p, leaf_e)
+        np.testing.assert_array_equal(np.asarray(rec_p.split_feature),
+                                      np.asarray(rec_e.split_feature))
+        np.testing.assert_array_equal(np.asarray(rec_p.split_bin),
+                                      np.asarray(rec_e.split_bin))
+        np.testing.assert_allclose(np.asarray(rec_p.leaf_output),
+                                   np.asarray(rec_e.leaf_output),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_proxy_leaf_counts_exact(self):
+        """leaf_count / internal_count come from partition-mask
+        counting and must equal a host recount of leaf_ids."""
+        rec, leaf_ids = self._grow(count_proxy=True, W=16)
+        nl = int(rec.num_leaves)
+        counts = np.asarray(rec.leaf_count)[:nl]
+        recount = np.array([(leaf_ids == k).sum() for k in range(nl)],
+                           np.float32)
+        np.testing.assert_array_equal(counts, recount)
+
+    def test_proxy_unfused_oracle_path(self):
+        """The XLA-oracle (non-fused) proxy path agrees with the fused
+        interpret path."""
+        rec_f, leaf_f = self._grow(count_proxy=True, W=8, fused=True)
+        rec_u, leaf_u = self._grow(count_proxy=True, W=8, fused=False)
+        np.testing.assert_array_equal(leaf_f, leaf_u)
+        np.testing.assert_array_equal(np.asarray(rec_f.split_feature),
+                                      np.asarray(rec_u.split_feature))
