@@ -101,17 +101,29 @@ lgb.train <- function(params = list(), data, nrounds = 10,
   bst
 }
 
-#' Cross validation (reference lgb.cv)
+#' Cross validation (reference lgb.cv, R-package/R/lgb.cv.R)
+#' @param folds optional list of test-index vectors (1-based), one per
+#'   fold — the reference's custom-folds path; overrides nfold
 #' @export
 lgb.cv <- function(params = list(), data, nrounds = 10, nfold = 3,
-                   stratified = TRUE, early_stopping_rounds = NULL,
-                   verbose = 1, ...) {
+                   folds = NULL, stratified = TRUE,
+                   early_stopping_rounds = NULL, verbose = 1, ...) {
   lgb <- .lgb_py()
   params <- c(params, list(...))
+  folds_py <- NULL
+  if (!is.null(folds)) {
+    # reference semantics: each element is that fold's TEST indices
+    # (1-based); the python cv complements them AFTER the dataset is
+    # constructed with the merged params (constructing here to learn
+    # num_data would freeze the bin mappers before cv's params apply)
+    folds_py <- lapply(folds, function(test_idx)
+      as.integer(test_idx - 1L))
+  }
   res <- lgb$cv(
     params = params,
     train_set = data,
     num_boost_round = as.integer(nrounds),
+    folds = folds_py,
     nfold = as.integer(nfold),
     stratified = stratified,
     early_stopping_rounds = if (is.null(early_stopping_rounds)) NULL
@@ -119,6 +131,47 @@ lgb.cv <- function(params = list(), data, nrounds = 10, nfold = 3,
     verbose_eval = verbose > 0
   )
   reticulate::py_to_r(res)
+}
+
+#' Field access on a Dataset (reference getinfo/setinfo,
+#' R-package/R/lgb.Dataset.R): fields label, weight, init_score, group
+#' @export
+getinfo <- function(dataset, ...) UseMethod("getinfo")
+
+#' @export
+getinfo.lgb.Dataset <- function(dataset, name, ...) {
+  v <- dataset$get_field(name)
+  if (is.null(v)) NULL else as.numeric(reticulate::py_to_r(v))
+}
+
+#' @export
+setinfo <- function(dataset, ...) UseMethod("setinfo")
+
+#' @export
+setinfo.lgb.Dataset <- function(dataset, name, info, ...) {
+  if (identical(name, "group")) {
+    dataset$set_field(name, as.integer(info))
+  } else {
+    dataset$set_field(name, as.numeric(info))
+  }
+  invisible(dataset)
+}
+
+#' Raw model serialization for R-native persistence (reference
+#' lgb.Booster.R: saveRDS.lgb.Booster / readRDS.lgb.Booster): the
+#' booster is captured as the LightGBM v2 model text, so the .rds file
+#' round-trips through any R session with no live Python handle
+#' @export
+saveRDS.lgb.Booster <- function(object, file, ...) {
+  raw_model <- reticulate::py_to_r(object$model_to_string())
+  saveRDS(list(lgb_tpu_raw_model = raw_model), file = file, ...)
+}
+
+#' @export
+readRDS.lgb.Booster <- function(file, ...) {
+  obj <- readRDS(file, ...)
+  stopifnot(!is.null(obj$lgb_tpu_raw_model))
+  lgb.load(model_str = obj$lgb_tpu_raw_model)
 }
 
 #' Simplified one-call interface (reference lightgbm())
@@ -254,7 +307,11 @@ lgb.interprete <- function(model, data, idxset) {
       off <- (k - 1L) * (nfeat + 1L)
       df[[col]] <- as.numeric(row[off + seq_len(nfeat + 1L)])
     }
-    df[order(-abs(df[[2L]])), ]
+    # rank by the largest-magnitude contribution across ALL classes
+    # (the reference orders per class; a single cross-class order keeps
+    # one data.frame per row while never sorting class k by class 0)
+    mag <- do.call(pmax, c(lapply(df[-1L], abs), list(na.rm = TRUE)))
+    df[order(-mag), ]
   })
 }
 

@@ -277,13 +277,21 @@ class Config:
     # count-proxy histograms (int8 quantized mode only): drop the count
     # channel from the MXU histogram dot so 2 channels x W <= 128 lanes
     # buys 64-leaf waves — fewer full-data passes per tree (~20% faster
-    # at HIGGS scale). Per-bin counts become hessian-proportional
-    # ESTIMATES used only by the min_data_in_leaf candidate gate;
-    # per-leaf counts (leaf_count / internal_count in the model file)
-    # stay exact via partition-mask counting. -1 = auto (on when
-    # tpu_quantized_hist and the fused kernel is eligible); 0 = off;
-    # 1 = on.
+    # at HIGGS scale). Per-bin counts become conservative LOWER BOUNDS
+    # (max(|sum g_q|, sum h_q)/127) consumed only by the
+    # min_data_in_leaf candidate gate, which can then over-prune but
+    # never admits a split the exact gate would reject; per-leaf counts
+    # (leaf_count / internal_count in the model file) stay exact via
+    # partition-mask counting. -1 = auto (on when tpu_quantized_hist
+    # and the fused kernel is eligible: serial/data learner, no EFB
+    # bundles, no forced splits, no categoricals); 0 = off; 1 = on.
     tpu_count_proxy: int = -1
+    # 4-bit packed HBM bins (the reference's Dense4bitsBin as a COMPUTE
+    # tier, dense_nbits_bin.hpp): when max_bin <= 16 and the count-proxy
+    # int8 path is active, two features share one byte in HBM and the
+    # Pallas kernels unpack nibbles in VMEM — half the bin-matrix HBM,
+    # double the rows/chip. -1 = auto (on when eligible); 0 = off.
+    tpu_packed_bins: int = -1
     # write an xprof/tensorboard device trace of the training loop here
     # (engine.train wraps the loop in jax.profiler.start/stop_trace)
     tpu_profile_dir: str = ""

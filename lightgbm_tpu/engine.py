@@ -152,8 +152,18 @@ def _train_loop(booster, params, init_iteration, num_boost_round,
     # (Booster.eval_dispatch_async), iteration i's metric scalars are
     # fetched WHILE iteration i+1 computes, so per-round evaluation
     # (early stopping) costs RPC latency, not training throughput.
-    # Custom fevals need host scores -> synchronous path.
-    pipelined = want_eval and feval is None
+    # Custom fevals need host scores -> synchronous path. USER
+    # callbacks also force the synchronous path: under pipelining an
+    # after-iteration callback for iteration i runs while the booster
+    # already holds iteration i+1's tree, so a user callback that
+    # snapshots the model or calls eval would silently observe the
+    # lookahead iteration. The built-in callbacks (print/record/early
+    # stopping) only read evaluation_result_list, which IS iteration
+    # i's, so they pipeline safely.
+    builtin_only = all(
+        getattr(cb, "__module__", None) == callback.__name__
+        for cb in callbacks_after_iter)
+    pipelined = want_eval and feval is None and builtin_only
     end_iteration = init_iteration + num_boost_round
     pending = None                    # (iteration, async eval handles)
 
@@ -239,6 +249,22 @@ def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
         if not hasattr(folds, "__iter__"):
             folds = folds.split(X=np.zeros(num_data),
                                 y=full_data.get_label())
+        else:
+            # normalize: elements are either (train_idx, test_idx)
+            # pairs (python convention) or bare TEST-index arrays (the
+            # reference R package's folds semantics, lgb.cv.R) whose
+            # train side is the complement
+            all_idx = np.arange(num_data)
+            norm = []
+            for fd in folds:
+                if (isinstance(fd, (tuple, list)) and len(fd) == 2
+                        and all(hasattr(x, "__len__") for x in fd)):
+                    norm.append((np.asarray(fd[0], np.int64),
+                                 np.asarray(fd[1], np.int64)))
+                else:
+                    te = np.asarray(list(fd), np.int64)
+                    norm.append((np.setdiff1d(all_idx, te), te))
+            folds = norm
     elif group is not None:
         # ranking: keep queries intact per fold (GroupKFold analog)
         group = np.asarray(group, np.int64)

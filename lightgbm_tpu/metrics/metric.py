@@ -77,7 +77,13 @@ class Metric:
     def _convert(self, score: np.ndarray, objective) -> np.ndarray:
         if objective is not None:
             import jax.numpy as jnp
-            return np.asarray(objective.convert_output(jnp.asarray(score)))
+            # upcast: convert_output computes in f32, but host metrics
+            # clip against f64 epsilons (1 - 1e-15 rounds to 1.0 in
+            # f32, which would turn log(1-p) into -inf on saturated
+            # sigmoid/softmax outputs; the reference evaluates in
+            # double throughout, binary_metric.hpp)
+            return np.asarray(objective.convert_output(jnp.asarray(score)),
+                              np.float64)
         return score
 
 
@@ -236,10 +242,20 @@ class BinaryLoglossMetric(Metric):
     name = "binary_logloss"
 
     def eval(self, score, objective):
-        p = self._convert(score[0] if score.ndim > 1 else score, objective)
+        s = score[0] if score.ndim > 1 else score
+        y = (self.label > 0).astype(np.float64)
+        if (objective is not None
+                and getattr(objective, "name", "") == "binary"):
+            # from RAW scores in f64 (reference semantics,
+            # binary_metric.hpp computes the sigmoid in double): the
+            # f32 convert_output saturates beyond |s·sigmoid| ~ 17
+            sa = float(objective.sigmoid) * np.asarray(s, np.float64)
+            loss = (y * np.logaddexp(0.0, -sa)
+                    + (1.0 - y) * np.logaddexp(0.0, sa))
+            return [(self.name, self._avg(loss))]
+        p = self._convert(s, objective)
         eps = 1e-15
         p = np.clip(p, eps, 1.0 - eps)
-        y = (self.label > 0).astype(np.float64)
         loss = -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
         return [(self.name, self._avg(loss))]
 
@@ -249,8 +265,24 @@ class BinaryLoglossMetric(Metric):
         n = self.num_data
         y = (lab > 0).astype(jnp.float32)
 
+        import jax
+        # sigmoid objectives: compute the loss from RAW scores via
+        # softplus — exact in f32, no probability clipping. The old
+        # clip-at-1e-7 capped per-row loss at ~16.1 vs the host path's
+        # ~34.5 and could shift early stopping on overfit runs.
+        sig = (getattr(objective, "sigmoid", None)
+               if objective is not None
+               and getattr(objective, "name", "") in ("binary",)
+               else None)
+
         def fn(scores):
             s = scores[0, :n]
+            if sig is not None:
+                sa = jnp.float32(sig) * s
+                # -log sigma(sa) = softplus(-sa); -log(1-sigma) = softplus(sa)
+                loss = (y * jax.nn.softplus(-sa)
+                        + (1.0 - y) * jax.nn.softplus(sa))
+                return self._dev_avg(loss, w)
             if objective is not None:
                 s = objective.convert_output(s)
             p = jnp.clip(s, 1e-7, 1.0 - 1e-7)   # f32-resolvable eps
@@ -350,10 +382,17 @@ class MultiLoglossMetric(Metric):
     name = "multi_logloss"
 
     def eval(self, score, objective):
-        p = self._convert(score, objective)      # [K, N]
-        k = p.shape[0]
-        eps = 1e-15
         y = self.label.astype(np.int64)
+        if (objective is not None
+                and getattr(objective, "name", "") == "multiclass"):
+            # raw-score f64 path: -log p_y = logsumexp(s) - s_y
+            s64 = np.asarray(score, np.float64)
+            mx = s64.max(axis=0)
+            lse = mx + np.log(np.exp(s64 - mx).sum(axis=0))
+            loss = lse - s64[y, np.arange(s64.shape[1])]
+            return [(self.name, self._avg(loss))]
+        p = self._convert(score, objective)      # [K, N]
+        eps = 1e-15
         py = np.clip(p[y, np.arange(p.shape[1])], eps, None)
         return [(self.name, self._avg(-np.log(py)))]
 
@@ -363,8 +402,18 @@ class MultiLoglossMetric(Metric):
         n = self.num_data
         y = lab.astype(jnp.int32)
 
+        import jax
+        # softmax objectives: -log p_y = logsumexp(s) - s_y on the RAW
+        # scores — exact in f32, no clipping (see binary logloss above)
+        softmax = (objective is not None
+                   and getattr(objective, "name", "") == "multiclass")
+
         def fn(scores):
             s = scores[:, :n]
+            if softmax:
+                loss = (jax.scipy.special.logsumexp(s, axis=0)
+                        - s[y, jnp.arange(n)])
+                return self._dev_avg(loss, w)
             if objective is not None:
                 s = objective.convert_output(s)
             py = jnp.clip(s[y, jnp.arange(n)], 1e-7, None)

@@ -116,6 +116,20 @@ class GBDT:
             bins_t = np.pad(bins_t, ((0, 0), (0, self._pad_rows)))
         if self._pad_features:
             bins_t = np.pad(bins_t, ((0, self._pad_features), (0, 0)))
+        self._num_bin_rows = bins_t.shape[0]
+        if self._grower_cfg.packed4:
+            # 4-bit tier: two features per HBM byte (low nibble = even
+            # feature). The grower's kernels unpack in VMEM; every
+            # OTHER consumer of the training bins (replay_partition in
+            # early-stop trimming, continued training, refit) must go
+            # through _train_bins_unpacked().
+            if bins_t.shape[0] % 2:
+                bins_t = np.pad(bins_t, ((0, 1), (0, 0)))
+            bins_t = (bins_t[0::2] | (bins_t[1::2] << 4)).astype(
+                np.uint8)
+            log.info("4-bit packed bins: %.1f MB HBM "
+                     "(vs %.1f MB unpacked)",
+                     bins_t.nbytes / 1e6, 2 * bins_t.nbytes / 1e6)
         with timing.phase("init/upload_bins"):
             self._bins_dev = jnp.asarray(bins_t)
         self._full_mask_dev = jnp.asarray(np.concatenate(
@@ -192,16 +206,23 @@ class GBDT:
         self._pad_rows = 0
         self._pad_features = 0
         meta = self._meta
+        # effective Pallas row chunk (must match the WaveGrowerConfig
+        # chunk below): rows are padded to a chunk multiple so the wave
+        # kernels never re-pad the [F, N] bins — an XLA pad there is a
+        # full-matrix copy per wave pass (~1 ms at the HIGGS shape,
+        # x11 passes/iter)
+        kchunk = (cfg.tpu_hist_chunk if cfg.tpu_hist_chunk > 0
+                  else 16384 if cfg.tpu_quantized_hist else 8192)
         if mode in ("data", "voting"):
             self._pad_rows = (-self._n) % D
+            if self._n >= 4 * D * kchunk:
+                # large shards: chunk-align each shard's rows too (the
+                # per-shard fused kernel re-pads otherwise); small test
+                # datasets skip this (padding would dwarf the data)
+                self._pad_rows = (-self._n) % (D * kchunk)
         elif mode == "serial":
             from ..utils.device import on_tpu
             if on_tpu():
-                # align rows to the Pallas kernel's chunk so the wave
-                # kernels never re-pad the [F, N] bins (a full-matrix
-                # copy per wave otherwise — ~0.1 ms/MB, every pass)
-                kchunk = cfg.tpu_hist_chunk if cfg.tpu_hist_chunk > 0 \
-                    else 8192
                 self._pad_rows = (-self._n) % kchunk
         if mode == "feature":
             self._pad_features = (-f) % D
@@ -233,12 +254,7 @@ class GBDT:
         # (measured 1.7s vs 83ms per tree at 1M rows). hi/lo f32-grade
         # accumulation (tpu_use_dp) needs 5W <= 128 -> W = 24; single
         # bf16 fused needs 4W <= 128 -> W = 32.
-        quant = (cfg.tpu_quantized_hist
-                 and mode in ("serial", "data", "voting"))
-        if cfg.tpu_quantized_hist and not quant:
-            log.warning("tpu_quantized_hist is not supported with "
-                        "tree_learner=feature; using %s histograms",
-                        "f32-grade" if cfg.tpu_use_dp else "bf16")
+        quant = cfg.tpu_quantized_hist
         # count-proxy (see config.tpu_count_proxy): int8-only, needs the
         # fused kernel's default seams — serial/data modes, no EFB
         # bundles, no forced splits (voting reads LOCAL count sums in
@@ -256,6 +272,9 @@ class GBDT:
                         "tree_learner serial/data, no EFB bundles, no "
                         "forced splits and no categorical features; "
                         "using exact counts")
+        # 4-bit packed HBM bins ride the proxy tier (see config)
+        packed4 = (proxy and self.train_data.max_bin_global <= 16
+                   and cfg.tpu_packed_bins != 0)
         if quant and proxy:
             precision, w_cap = "int8", 64    # 2ch (count-proxy) cap 64
             hp = hp._replace(count_lb=True)  # conservative min_data gate
@@ -279,13 +298,14 @@ class GBDT:
             max_depth=cfg.max_depth,
             # int8 kernels measured fastest at 16k-row chunks (the
             # 2-channel working set leaves the VMEM headroom for it);
-            # other tiers keep the implementation default (8192)
-            chunk=(cfg.tpu_hist_chunk if cfg.tpu_hist_chunk > 0
-                   else 16384 if quant else 0),
+            # other tiers keep the implementation default (8192).
+            # kchunk (computed above) kept in sync for row padding.
+            chunk=kchunk,
             hp=hp,
             precision=precision,
             forced=self._parse_forced_splits(),
-            count_proxy=proxy)
+            count_proxy=proxy,
+            packed4=packed4)
         self._grower_cfg = gcfg
         hist_fn = None
         if self._use_bundles:
@@ -433,7 +453,7 @@ class GBDT:
                                 for k, v in arrs.items()})
             self.records.append(rec)
             cls = t_idx % self.num_tree_per_iteration
-            leaf = replay_partition(rec, self._bins_dev, self._meta)
+            leaf = replay_partition(rec, self._train_bins_unpacked(), self._meta)
             self._scores = self._scores.at[cls].set(add_leaf_outputs(
                 self._scores[cls], leaf[:self._n], rec.leaf_output, 1.0))
         self.iter_ = len(loaded_models) // self.num_tree_per_iteration
@@ -455,6 +475,19 @@ class GBDT:
         mask[idx] = 1.0
         self._bag_cache = mask
         return mask
+
+    def _train_bins_unpacked(self) -> jax.Array:
+        """Training bins as [F, N] — transient nibble-unpack when the
+        4-bit packed tier is active (replay_partition and friends index
+        per-feature rows; only the grower kernels understand packed
+        bytes)."""
+        if not self._grower_cfg.packed4:
+            return self._bins_dev
+        b = self._bins_dev
+        lo = jnp.bitwise_and(b, jnp.uint8(15))
+        hi = jnp.right_shift(b, jnp.uint8(4))
+        return jnp.stack([lo, hi], axis=1).reshape(
+            -1, b.shape[1])[:self._num_bin_rows]
 
     def _feature_mask(self) -> np.ndarray:
         cfg = self.config
@@ -705,7 +738,7 @@ class GBDT:
                 rec = self.records.pop()
                 self.models.pop()
                 self._tree_shrinkage.pop()
-                leaf = replay_partition(rec, self._bins_dev,
+                leaf = replay_partition(rec, self._train_bins_unpacked(),
                                         self._meta)[:self._n]
                 self._scores = self._scores.at[k].set(add_leaf_outputs(
                     self._scores[k], leaf, rec.leaf_output, -1.0))
@@ -1050,7 +1083,7 @@ class GBDT:
             for k in range(K):
                 t = it * K + k
                 rec = self.records[t]
-                leaf = replay_partition(rec, self._bins_dev,
+                leaf = replay_partition(rec, self._train_bins_unpacked(),
                                         self._meta)[:self._n]
                 new_scores, out = refit_one(
                     self._scores[k], rec.leaf_output, leaf,
@@ -1080,6 +1113,26 @@ class GBDT:
         self._best_msg = [[""] * len(ms) for ms in self.valid_metrics]
         start_time = time.monotonic()
         is_finished = False
+
+        def materialize(handles):
+            return {idx: ([] if entry is None else
+                          [(m.name, float(v), m.bigger_is_better)
+                           for m, v in zip(entry[0],
+                                           np.asarray(entry[1]))])
+                    for idx, entry in handles.items()}
+
+        # Pipelined (one-iteration lookahead) evaluation, like
+        # engine._train_loop: iteration N's device metric scalars are
+        # dispatched right after its update and MATERIALIZED while
+        # iteration N+1 trains, so per-round eval (early stopping)
+        # costs RPC latency instead of a pipeline bubble. Metric lines
+        # keep the reference format and iteration indices
+        # (gbdt.cpp:466-534); they just print one training iteration
+        # later. Falls back to the synchronous path when any metric
+        # lacks a device implementation.
+        pipeline_ok = True
+        pending = None            # (iteration index, dispatched handles)
+        trained = 0
         # num_iterations counts ADDITIONAL rounds on top of a loaded
         # input_model, like the reference's train loop (gbdt.cpp:248
         # iterates config num_iterations times from the loaded state);
@@ -1087,8 +1140,35 @@ class GBDT:
         # counter (gbdt.cpp:255-260 uses its loop-local iter + 1)
         for add in range(cfg.num_iterations):
             is_finished = self.train_one_iter()
+            trained = add + 1
             if not is_finished:
-                is_finished = self._eval_and_check_early_stopping(add + 1)
+                it = add + 1
+                handles = (self._eval_dispatch(it) if pipeline_ok
+                           else None)
+                if handles is None:
+                    pipeline_ok = False
+                if pipeline_ok:
+                    if pending is not None:
+                        pit, ph = pending
+                        if self._eval_and_check_early_stopping(
+                                pit, values=materialize(ph),
+                                extra_drop=it - pit):
+                            pending = None
+                            is_finished = True
+                    if not is_finished:
+                        pending = (it, handles)
+                else:
+                    if pending is not None:
+                        # drain the lookahead before going synchronous
+                        pit, ph = pending
+                        pending = None
+                        if self._eval_and_check_early_stopping(
+                                pit, values=materialize(ph),
+                                extra_drop=it - pit):
+                            is_finished = True
+                    if not is_finished:
+                        is_finished = \
+                            self._eval_and_check_early_stopping(it)
             log.info("%f seconds elapsed, finished iteration %d",
                      time.monotonic() - start_time, add + 1)
             if snapshot_freq > 0 and (add + 1) % snapshot_freq == 0:
@@ -1096,6 +1176,12 @@ class GBDT:
                     f"{output_model}.snapshot_iter_{add + 1}")
             if is_finished:
                 break
+        if pending is not None:
+            # flush the final lookahead so the last iteration's metric
+            # lines (and a possible last-moment stop) are not lost
+            pit, ph = pending
+            self._eval_and_check_early_stopping(
+                pit, values=materialize(ph), extra_drop=trained - pit)
         self.finish_training()
         if output_model:
             with timing.phase("io/save_model"):
@@ -1104,10 +1190,15 @@ class GBDT:
         timing.log_report("training phase timings "
                           "(serial_tree_learner.cpp:14-41 analog)")
 
-    def _eval_and_check_early_stopping(self, it: int) -> bool:
+    def _eval_and_check_early_stopping(self, it: int, values=None,
+                                       extra_drop: int = 0) -> bool:
         # ``it`` counts additional rounds like the reference's iter_
-        # (reset to 0 on model load, gbdt_model_text.cpp:485)
-        best_msg = self._output_metric(it)
+        # (reset to 0 on model load, gbdt_model_text.cpp:485).
+        # ``values``: pre-materialized {data_idx: [(name, val,
+        # bigger)]} from the pipelined dispatch; ``extra_drop``:
+        # lookahead iterations trained beyond ``it`` that must also be
+        # popped on stop so the kept model still ends at it - es.
+        best_msg = self._output_metric(it, values)
         if not best_msg:
             return False
         es = self.config.early_stopping_round
@@ -1116,20 +1207,55 @@ class GBDT:
         log.info("Early stopping at iteration %d, the best iteration "
                  "round is %d", it, it - es)
         log.info("Output of best iteration round:\n%s", best_msg)
-        self._drop_last_iterations(es)
+        self._drop_last_iterations(es + extra_drop)
         return True
 
-    def _output_metric(self, it: int) -> str:
-        """OutputMetric (gbdt.cpp:466-534): print metrics at metric_freq
-        and run the early-stopping bookkeeping; returns the best-round
-        message when the stop condition is met."""
+    def _eval_dispatch(self, it: int):
+        """Dispatch (without materializing) the device-metric
+        reductions iteration ``it`` will need. Returns {data_idx:
+        (metrics, device_values) | None-for-empty} or None when some
+        needed dataset has no all-device metric set (sync fallback)."""
         cfg = self.config
         need_output = cfg.metric_freq > 0 and (it % cfg.metric_freq) == 0
         es_round = cfg.early_stopping_round
+        want = {}
+        if need_output and self.training_metrics:
+            want[0] = self.training_metrics
+        if need_output or es_round > 0:
+            for i in range(len(self.valid_sets)):
+                want[i + 1] = self.valid_metrics[i]
+        out = {}
+        for idx, metrics in want.items():
+            if not metrics:
+                out[idx] = None
+                continue
+            fn = self._device_eval_fn(idx, metrics)
+            if fn is None:
+                return None
+            scores = (self._scores if idx == 0
+                      else self._valid_scores[idx - 1])
+            out[idx] = (metrics, fn(scores))
+        return out
+
+    def _output_metric(self, it: int, values=None) -> str:
+        """OutputMetric (gbdt.cpp:466-534): print metrics at metric_freq
+        and run the early-stopping bookkeeping; returns the best-round
+        message when the stop condition is met. ``values``: optional
+        pre-materialized {data_idx: [(name, val, bigger)]} (the
+        pipelined train loop) instead of synchronous get_eval_at."""
+        cfg = self.config
+        need_output = cfg.metric_freq > 0 and (it % cfg.metric_freq) == 0
+        es_round = cfg.early_stopping_round
+
+        def evals(idx):
+            if values is not None:
+                return values.get(idx, [])
+            return self.get_eval_at(idx)
+
         ret = ""
         msg_lines: List[str] = []
         if need_output:
-            for name, val, _ in self.get_eval_at(0):
+            for name, val, _ in evals(0):
                 line = f"Iteration:{it}, training {name} : {val:g}"
                 log.info("%s", line)
                 if es_round > 0:
@@ -1138,7 +1264,7 @@ class GBDT:
         if need_output or es_round > 0:
             for i in range(len(self.valid_sets)):
                 for j, (name, val, bigger) in enumerate(
-                        self.get_eval_at(i + 1)):
+                        evals(i + 1)):
                     line = (f"Iteration:{it}, valid_{i + 1} {name}"
                             f" : {val:g}")
                     if need_output:

@@ -46,6 +46,21 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _feature_row(bins_ref, f: int, cache: dict, packed4: bool):
+    """Logical feature ``f``'s bin row as i32 lanes (shared by the wave
+    and fused kernels). 4-bit tier: two features per byte row (feature
+    2p in the low nibble of row p); each byte row is widened once per
+    kernel invocation via ``cache``."""
+    if not packed4:
+        return bins_ref[f, :].astype(jnp.int32)
+    pr = f // 2
+    if pr not in cache:
+        cache[pr] = bins_ref[pr, :].astype(jnp.int32)
+    r = cache[pr]
+    return (jax.lax.shift_right_logical(r, 4) if f % 2
+            else jnp.bitwise_and(r, 15))
+
+
 def _bf16_split(x):
     """Split f32 into (hi, lo) with hi exactly bf16-representable and
     hi + lo == x exactly. Bit-truncation of the low 16 mantissa bits —
@@ -114,7 +129,7 @@ def wave_histogram_xla(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 
 def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
                       groups, group_sz, hilo, exact_dot=False,
-                      int8=False, count_proxy=False):
+                      int8=False, count_proxy=False, packed4=False):
     """One grid step = one row chunk; accumulates into out_ref (VMEM).
 
     Every tensor keeps ROWS ON THE LANE AXIS — no relayouts anywhere:
@@ -180,6 +195,7 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
         w_mm = w_rows if exact_dot else w_rows.astype(jnp.bfloat16)
         acc_dt = jnp.float32
 
+    rows_cache = {}
     for p in range(groups):
         # per-feature one-hot blocks concatenated on ALIGNED sublane
         # boundaries: one compare per feature (the previous
@@ -189,7 +205,7 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
         for sidx in range(group_sz):
             f = p * group_sz + sidx
             if f < F:
-                row = bins_ref[f, :].astype(jnp.int32)  # [Ct] lanes
+                row = _feature_row(bins_ref, f, rows_cache, packed4)
                 blocks.append(
                     (row[None, :] == bin_iota).astype(oh_dt))
             else:
@@ -216,10 +232,12 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "chunk", "interpret",
-                                    "precision", "count_proxy"))
+                                    "precision", "count_proxy",
+                                    "packed4", "num_features"))
 def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                           chunk=2048, interpret=False, precision="highest",
-                          gh_scale=None, count_proxy=False):
+                          gh_scale=None, count_proxy=False,
+                          packed4=False, num_features=None):
     """Pallas wave histogram — same contract as wave_histogram_xla.
 
     Grid over row chunks; per chunk the kernel builds the leaf-membership
@@ -238,6 +256,11 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     output back to f32 sums.
     """
     F, n = bins_t.shape
+    if packed4:
+        if not count_proxy or num_bins > 16:
+            raise NotImplementedError(
+                "packed4 needs count_proxy and max_bin <= 16")
+        F = int(num_features)
     W = int(wave_leaves.shape[0])
     B = num_bins
     int8 = precision == "int8"
@@ -279,15 +302,16 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     kernel = functools.partial(
         _wave_hist_kernel, F=F, B=B, W=W, groups=groups,
         group_sz=group_sz, hilo=hilo, exact_dot=interpret and not int8,
-        int8=int8, count_proxy=count_proxy)
+        int8=int8, count_proxy=count_proxy, packed4=packed4)
 
+    F_rows = bins_t.shape[0]         # packed4: ceil(F/2) byte rows
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // chunk,),
         in_specs=[
             pl.BlockSpec((wp, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((F, chunk), lambda i: (0, i),
+            pl.BlockSpec((F_rows, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((4, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
@@ -381,7 +405,7 @@ FUSED_MAX_WAVE_INT8_NC = 64  # 2 channels (count-proxy mode: the MXU dot
 def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
                   hist_ref, leaf_out_ref, *maybe_cnt, F, B, W, groups,
                   group_sz, hilo, exact_dot=False, int8=False,
-                  any_cat=True, count_proxy=False):
+                  any_cat=True, count_proxy=False, packed4=False):
     """One grid step: partition one row chunk by the wave's W splits,
     then accumulate the wave's smaller-child histograms — ONE data pass.
 
@@ -441,7 +465,25 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     # and it replaces the previous F-deep select sweep over [W, Ct]
     # (F x W VPU ops per row) with an F-contraction matmul.
     feat_c = tbl_ref[:W, TBL_FEAT:TBL_FEAT + 1]
-    if B <= 128:
+    if packed4:
+        # 4-bit tier (dense_nbits_bin.hpp analog): two features per
+        # HBM byte. Gather the PACKED byte rows (values <= 255: exact
+        # bf16), then select each slot's nibble by feat & 1.
+        F2 = binsf_ref.shape[0]
+        feat2_c = jax.lax.shift_right_logical(feat_c, 1)
+        odd_c = jnp.bitwise_and(feat_c, 1)
+        f_iota2 = jax.lax.broadcasted_iota(i32, (W, F2), 1)
+        feat_oh = (f_iota2 == feat2_c).astype(jnp.bfloat16)
+        bins_bf = binsf_ref[...].astype(i32) \
+            .astype(jnp.bfloat16)                           # [F2, Ct]
+        packed_cols = jax.lax.dot_general(
+            feat_oh, bins_bf,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(i32)  # [W, Ct]
+        cols = jnp.where(odd_c > 0,
+                         jax.lax.shift_right_logical(packed_cols, 4),
+                         jnp.bitwise_and(packed_cols, 15))
+    elif B <= 128:
         # int8 gather: bin values <= 127 are exact int8, the one-hot
         # row-select dot runs at the MXU's 2x int8 rate and accumulates
         # exactly in int32
@@ -569,12 +611,13 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
         oh_dt = jnp.float32 if exact_dot else jnp.bfloat16
         w_mm = w_rows if exact_dot else w_rows.astype(jnp.bfloat16)
         acc_dt = jnp.float32
+    rows_cache = {}
     for p in range(groups):
         blocks = []
         for sidx in range(group_sz):
             f = p * group_sz + sidx
             if f < F:
-                row = binsf_ref[f, :].astype(i32)
+                row = _feature_row(binsf_ref, f, rows_cache, packed4)
                 blocks.append(
                     (row[None, :] == bin_iota).astype(oh_dt))
             else:
@@ -595,13 +638,15 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk",
                                              "interpret", "precision",
-                                             "any_cat", "count_proxy"))
+                                             "any_cat", "count_proxy",
+                                             "packed4", "num_features"))
 def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
                                      leaf_ids, tbl, *, num_bins,
                                      chunk=2048, interpret=False,
                                      precision="highest",
                                      gh_scale=None, any_cat=True,
-                                     count_proxy=False):
+                                     count_proxy=False, packed4=False,
+                                     num_features=None):
     """Partition one wave + build its smaller-child histograms in ONE
     data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]) — or, with
     ``count_proxy``, (new_leaf_ids, hist [W, F, B, 2], cnt_right [W]).
@@ -620,8 +665,20 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     passes per tree). The returned ``cnt_right`` holds each slot's
     EXACT in-bag row count moved to the new (right) child; per-bin
     count estimates are synthesized downstream (wave_grower).
+
+    packed4 (count-proxy tier only): ``bins_t`` is [ceil(F/2), N] with
+    TWO features' 4-bit bins per byte (feature 2p in the low nibble of
+    row p) — half the HBM residency for max_bin <= 16 datasets, like
+    the reference's Dense4bitsBin (dense_nbits_bin.hpp); the kernel
+    unpacks nibbles in VMEM. ``num_features`` gives the logical F.
     """
     F, n = bins_t.shape
+    if packed4:
+        if not count_proxy:
+            raise NotImplementedError("packed4 requires count_proxy")
+        if num_bins > 16:
+            raise NotImplementedError("packed4 needs max_bin <= 16")
+        F = int(num_features)
     W = int(tbl.shape[1])
     B = num_bins
     int8 = precision == "int8"
@@ -666,7 +723,8 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     kernel = functools.partial(
         _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz,
         hilo=hilo, exact_dot=interpret and not int8, int8=int8,
-        any_cat=any_cat, count_proxy=count_proxy)
+        any_cat=any_cat, count_proxy=count_proxy, packed4=packed4)
+    F_rows = bins_t.shape[0]         # packed4: ceil(F/2) byte rows
 
     wp = _round_up(W, 8)
     out_specs = [
@@ -690,7 +748,7 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
         in_specs=[
             pl.BlockSpec((128, TBL_ROWS), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((F, chunk), lambda i: (0, i),
+            pl.BlockSpec((F_rows, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((4, chunk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),

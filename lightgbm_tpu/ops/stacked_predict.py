@@ -23,6 +23,14 @@ to three dense contractions per tree chunk:
 
 No gathers, no per-tree dispatch: a 500-tree model predicts in one
 host->device upload per row chunk and ~T/TC fused scan steps.
+
+Numerical note: leaf values and per-row score accumulation run in
+float32 on device (the reference accumulates in double,
+gbdt_prediction.cpp). Expect ~1e-7 RELATIVE error that grows with
+leaf-value magnitude and tree count; for parity-sensitive comparisons
+against the reference at f64 resolution, use the host prediction path
+(``use_pallas=False`` routes chunks through the same f32 kernels —
+the exact-f64 path is the per-tree host traversal, models/tree.py).
 """
 from __future__ import annotations
 
@@ -142,11 +150,14 @@ class StackedModel:
                 over = (np.nextafter(edges[-1], np.inf)
                         if edges.size else 0.0)
                 rep = np.concatenate([edges, [over, np.nan]])
-            # widths 8-aligned: the Pallas forest kernel builds the
-            # one-hot on the sublane axis in per-feature blocks, and
-            # Mosaic wants 8-aligned sublane starts; padded slots have
-            # all-zero W rows and are never addressed by a code
-            widths[f] = -(-rep.size // 8) * 8
+            # widths bucketed to 32 (8-aligned sublane starts are a
+            # Mosaic requirement; the coarser bucket makes the kernel
+            # SHAPE stable across models — e.g. every max_bin=63
+            # feature lands on width 64 — so the persistent compile
+            # cache serves repeat predicts instead of a fresh ~40 s
+            # Mosaic compile per model). Padded slots have all-zero W
+            # rows and are never addressed by a code.
+            widths[f] = -(-rep.size // 32) * 32
             reps.append(rep)
         self._rep_sizes = np.array([r.size for r in reps], np.int64)
         self._offsets = np.concatenate([[0], np.cumsum(widths)])
@@ -394,21 +405,43 @@ class StackedModel:
         tc = self._pallas_tc() if forest else None
         forest = forest and tc is not None
         if forest and not pred_leaf:
-            # fused forest kernel: the whole ensemble in ONE dispatch
+            # fused forest kernel, dispatched per ROW CHUNK: every
+            # chunk's [chunk, K] f32 result is queued asynchronously,
+            # so the per-chunk downloads overlap the remaining chunks'
+            # compute — on an RPC-tunneled device the transfer wall
+            # otherwise serializes after the math. f32 on the wire
+            # (f64 only at this API boundary, predictor.hpp-style)
+            # halves the download.
             dev = self._device_arrays_pallas(first, ntree, tc)
             offs = tuple(int(o) for o in self._offsets)
-            if dev_bin:
-                acc = forest_predict_from_x(
-                    jnp.asarray(rows), jnp.asarray(self._E_f32),
-                    jnp.asarray(self._off32),
-                    jnp.asarray(self._nan_slot), *dev,
-                    offsets=offs, interpret=not on_tpu())
-            else:
-                codes_t = jnp.asarray(np.ascontiguousarray(rows.T))
-                acc = forest_predict_pallas(
-                    codes_t, *dev, offsets=offs,
-                    interpret=not on_tpu())
-            return np.asarray(acc).T.astype(np.float64)
+            interp = not on_tpu()
+            fchunk = 1 << 18
+            handles = []
+            for c0 in range(0, N, fchunk):
+                part = rows[c0:c0 + fchunk]
+                nrows = part.shape[0]
+                if nrows < fchunk and N > fchunk:
+                    # zero-pad the tail chunk to the full chunk shape
+                    # so it reuses the same compiled kernel (padded
+                    # rows produce garbage scores, sliced off below)
+                    part = np.concatenate([part, np.zeros(
+                        (fchunk - nrows,) + part.shape[1:],
+                        part.dtype)])
+                if dev_bin:
+                    h = forest_predict_from_x(
+                        jnp.asarray(part), jnp.asarray(self._E_f32),
+                        jnp.asarray(self._off32),
+                        jnp.asarray(self._nan_slot), *dev,
+                        offsets=offs, interpret=interp)
+                else:
+                    codes_t = jnp.asarray(
+                        np.ascontiguousarray(part.T))
+                    h = forest_predict_pallas(
+                        codes_t, *dev, offsets=offs, interpret=interp)
+                handles.append((h, nrows))
+            acc = np.concatenate(
+                [np.asarray(h)[:nr] for h, nr in handles], axis=0)
+            return acc.T.astype(np.float64)
         dev = self._device_arrays(first, ntree)
         # pad rows to a power-of-two bucket so repeated odd-sized calls
         # reuse one compiled kernel instead of recompiling per shape

@@ -74,6 +74,12 @@ class WaveGrowerConfig(NamedTuple):
     # stay EXACT — each wave's kernel counts the rows it moved, so
     # leaf_count/internal_count in the model match the exact path.
     count_proxy: bool = False
+    # 4-bit packed HBM bins (count-proxy tier only, max_bin <= 16):
+    # grow() receives bins_t as [ceil(F/2), N] bytes with two features'
+    # nibbles per byte (reference Dense4bitsBin, dense_nbits_bin.hpp);
+    # the fused kernel unpacks in VMEM, halving HBM residency. The
+    # non-fused fallback unpacks once up front.
+    packed4: bool = False
 
 
 class _State(NamedTuple):
@@ -173,6 +179,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     if proxy and (hist_fn is not None or partition_fn is not None):
         raise ValueError("count_proxy does not compose with injected "
                          "histogram/partition seams")
+    if cfg.packed4 and not proxy:
+        raise ValueError("packed4 bins require count_proxy mode")
     if quant and hist_fn is not None:
         # an injected histogram seam must understand quantized g/h —
         # silently dropping gh_scale would produce garbage histograms
@@ -265,6 +273,15 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         """
         F, n = bins_t.shape
         f32 = jnp.float32
+        if cfg.packed4:
+            F = int(feature_mask.shape[0])       # logical features
+            if not use_fused:
+                # oracle/fallback path: unpack nibbles once up front
+                # (row 2p = low nibble of byte row p)
+                lo = jnp.bitwise_and(bins_t, jnp.uint8(15))
+                hi = jnp.right_shift(bins_t, jnp.uint8(4))
+                bins_t = jnp.stack([lo, hi], axis=1).reshape(
+                    -1, bins_t.shape[1])[:F]
         grad = grad.astype(f32) * sample_mask
         hess = hess.astype(f32) * sample_mask
         in_bag = sample_mask > 0
@@ -321,7 +338,9 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 bins_t, hg, hh, bag_mask_ids(leaf0), root_wl,
                 num_bins=B, chunk=cfg.chunk or 8192,
                 interpret=fused_interpret, precision=cfg.precision,
-                gh_scale=gh_scale, count_proxy=True)
+                gh_scale=gh_scale, count_proxy=True,
+                packed4=cfg.packed4,
+                num_features=F if cfg.packed4 else None)
         else:
             local_root = call_hist(bins_t, bag_mask_ids(leaf0),
                                    root_wl)              # [W, F, B, 3]
@@ -449,7 +468,9 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     state.leaf_ids, tbl, num_bins=B,
                     chunk=cfg.chunk or 8192, interpret=fused_interpret,
                     precision=cfg.precision, gh_scale=gh_scale,
-                    any_cat=bool(hp.has_cat), count_proxy=proxy)
+                    any_cat=bool(hp.has_cat), count_proxy=proxy,
+                    packed4=cfg.packed4,
+                    num_features=F if cfg.packed4 else None)
                 leaf_ids, hist_small = fused_out[0], fused_out[1]
                 hist_small = hist_reduce_fn(hist_small)
                 if proxy:

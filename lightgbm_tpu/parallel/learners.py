@@ -113,11 +113,12 @@ def _slice_meta(meta: FeatureMeta, start, size: int) -> FeatureMeta:
 
 
 def _hist(cfg: WaveGrowerConfig):
-    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
+    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves, gh_scale=None):
         return wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
                               num_bins=cfg.num_bins, chunk=cfg.chunk,
                               use_pallas=cfg.use_pallas,
-                              precision=cfg.precision)
+                              precision=cfg.precision,
+                              gh_scale=gh_scale)
     return hist_fn
 
 
@@ -170,10 +171,15 @@ def make_feature_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     Fd = num_features // D
     local_hist = _hist(cfg)
 
-    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves):
+    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves, gh_scale=None):
+        # int8 quantization composes: every device holds ALL rows, so
+        # the (global-max) scales and the stochastic-rounding key are
+        # identical on every device and the feature-sliced histograms
+        # dequantize consistently
         i = jax.lax.axis_index(AXIS)
         local_bins = jax.lax.dynamic_slice_in_dim(bins_t, i * Fd, Fd, 0)
-        return local_hist(local_bins, g, h, leaf_ids, wave_leaves)
+        return local_hist(local_bins, g, h, leaf_ids, wave_leaves,
+                          gh_scale=gh_scale)
 
     def split_fn(hists, sg, sh, nd, fmask, can):
         i = jax.lax.axis_index(AXIS)

@@ -378,3 +378,77 @@ LIGHTGBM_C_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle,
                    (unsigned long long)(uintptr_t)handle,
                    start_iteration, num_iteration, filename);
 }
+
+// ---------------------------------------------------------------------------
+// Plain-C parameter forms.
+//
+// The fork's c_api.h passes parameters as C++ std::unordered_map BY
+// VALUE in four entry points — fine for a C++ translation unit that
+// includes the header, but uncallable through a pure-C FFI (JNI
+// RegisterNatives, Java's Panama FFM, ctypes, dlsym users). These
+// variants take the upstream LightGBM convention instead — a single
+// "key=value key2=value2" C string — and are what
+// java/LightGbmTpuNative.java binds to. Same handles, same glue.
+// ---------------------------------------------------------------------------
+
+LIGHTGBM_C_EXPORT int LGBM_DatasetCreateFromMatC(
+    const void* data, int data_type, int32_t nrow, int32_t ncol,
+    int is_row_major, const char* parameters,
+    const DatasetHandle reference, DatasetHandle* out) {
+  long long h = as_ll(call(
+      "dataset_from_mat", "(KiiiisK)",
+      (unsigned long long)(uintptr_t)data, data_type, (int)nrow,
+      (int)ncol, is_row_major, parameters ? parameters : "",
+      (unsigned long long)(uintptr_t)reference));
+  if (h < 0) return -1;
+  *out = (DatasetHandle)(uintptr_t)h;
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterCreateC(
+    const DatasetHandle train_data, const char* parameters,
+    BoosterHandle* out) {
+  long long h = as_ll(call(
+      "booster_create", "(Ks)",
+      (unsigned long long)(uintptr_t)train_data,
+      parameters ? parameters : ""));
+  if (h < 0) return -1;
+  *out = (BoosterHandle)(uintptr_t)h;
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForMatC(
+    BoosterHandle handle, const void* data, int data_type, int32_t nrow,
+    int32_t ncol, int is_row_major, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  long long v = as_ll(call(
+      "booster_predict_mat", "(KKiiiiiisK)",
+      (unsigned long long)(uintptr_t)handle,
+      (unsigned long long)(uintptr_t)data, data_type, (int)nrow,
+      (int)ncol, is_row_major, predict_type, num_iteration,
+      parameter ? parameter : "",
+      (unsigned long long)(uintptr_t)out_result));
+  if (v < 0) return -1;
+  *out_len = (int64_t)v;
+  return 0;
+}
+
+LIGHTGBM_C_EXPORT int LGBM_BoosterPredictForCSRC(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  long long v = as_ll(call(
+      "booster_predict_csr", "(KKiKKiLLLiisK)",
+      (unsigned long long)(uintptr_t)handle,
+      (unsigned long long)(uintptr_t)indptr, indptr_type,
+      (unsigned long long)(uintptr_t)indices,
+      (unsigned long long)(uintptr_t)data, data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col,
+      predict_type, num_iteration, parameter ? parameter : "",
+      (unsigned long long)(uintptr_t)out_result));
+  if (v < 0) return -1;
+  *out_len = (int64_t)v;
+  return 0;
+}

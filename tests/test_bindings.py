@@ -50,7 +50,9 @@ def test_r_binding_covers_reference_core_api():
                "lgb.model.dt.tree", "lgb.interprete",
                "lgb.plot.importance", "lgb.plot.interpretation",
                "lgb.Dataset.save", "lgb.slice.Dataset",
-               "lgb.get.eval.result"):
+               "lgb.get.eval.result", "getinfo.lgb.Dataset",
+               "setinfo.lgb.Dataset", "saveRDS.lgb.Booster",
+               "readRDS.lgb.Booster"):
         assert re.search(rf"^{re.escape(fn)} <- function",
                          src, re.M), f"R function {fn} missing"
 
@@ -141,3 +143,67 @@ public class Driver {{
     r = subprocess.run(["java", "-cp", str(build), "Driver"],
                        capture_output=True, text=True, timeout=600)
     assert "JAVA-BINDING-OK" in r.stdout, r.stderr
+
+
+JAVA_FFM_SRC = REPO / "java" / "LightGbmTpuNative.java"
+C_ABI_SRC = REPO / "native" / "c_api_embed.cpp"
+
+
+def test_java_ffm_binding_symbols_exist_in_c_abi():
+    """Every native symbol the Panama-FFM binding downcalls must be an
+    exported entry point of native/c_api_embed.cpp — pins the in-process
+    surface (create/train/predict/save/load/eval/free) against the .so."""
+    import re
+    src = JAVA_FFM_SRC.read_text()
+    syms = set(re.findall(r'down\("(LGBM_\w+)"', src))
+    assert len(syms) >= 15, sorted(syms)
+    required = {
+        "LGBM_DatasetCreateFromMatC", "LGBM_DatasetCreateFromFile",
+        "LGBM_DatasetSetField", "LGBM_DatasetFree",
+        "LGBM_BoosterCreateC", "LGBM_BoosterCreateFromModelfile",
+        "LGBM_BoosterUpdateOneIter", "LGBM_BoosterPredictForMatC",
+        "LGBM_BoosterSaveModel", "LGBM_BoosterGetEval",
+        "LGBM_BoosterFree",
+    }
+    assert required <= syms, required - syms
+    cpp = C_ABI_SRC.read_text()
+    exported = set(re.findall(
+        r"LIGHTGBM_C_EXPORT[\w\s*]+?(LGBM_\w+)\s*\(", cpp))
+    missing = syms - exported
+    assert not missing, f"FFM binds symbols the .so does not export: " \
+                        f"{sorted(missing)}"
+    # per-row predict (the point of an in-process binding) is present
+    assert "predictRow" in src
+
+
+@pytest.mark.skipif(shutil.which("javac") is None
+                    or shutil.which("java") is None,
+                    reason="no JDK in image")
+def test_java_ffm_train_predict_inprocess(tmp_path):
+    """Compile the FFM binding and run its main(): in-process train,
+    per-row predict, save, reload, re-predict through the embedded
+    .so — no subprocess spawn per call."""
+    import os
+    import sysconfig
+    so = tmp_path / "liblightgbm_tpu.so"
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    r = subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++14",
+         str(REPO / "native" / "c_api_embed.cpp"), "-o", str(so),
+         f"-I{inc}", f"-L{libdir}", f"-l{pyver}", "-ldl", "-lm",
+         f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    build = tmp_path / "classes"
+    subprocess.run(["javac", "-d", str(build), str(JAVA_FFM_SRC)],
+                   check=True)
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    r = subprocess.run(
+        ["java", "--enable-native-access=ALL-UNNAMED", "-cp",
+         str(build), "LightGbmTpuNative", str(so),
+         str(tmp_path / "model.txt")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "JAVA_FFM_OK" in r.stdout

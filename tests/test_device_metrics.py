@@ -128,3 +128,41 @@ def test_pipelined_early_stopping_matches_sync():
     assert fast.num_trees() == slow.num_trees()
     np.testing.assert_allclose(
         fast.predict(Xv[:100]), slow.predict(Xv[:100]), atol=1e-6)
+
+
+def test_logloss_confident_mispredictions_exact():
+    """Device logloss is computed from RAW scores (softplus /
+    logsumexp) — no probability clipping, so confident mispredictions
+    (|raw| ~ 30) give the same value as the f64 host path instead of
+    being capped at -log(1e-7)."""
+    r = np.random.default_rng(3)
+    n = 400
+    y = (r.random(n) > 0.5).astype(np.float32)
+    raw = np.where(y > 0, -30.0, 30.0).astype(np.float32)  # all wrong
+    raw[: n // 4] *= -1                                    # some right
+    _parity(["binary_logloss"], "binary", y, raw[None, :])
+    y3 = r.integers(0, 3, n).astype(np.float32)
+    raw3 = r.normal(size=(3, n)).astype(np.float32) * 20.0
+    _parity(["multi_logloss"], "multiclass", y3, raw3, num_class=3)
+
+
+def test_user_callback_sees_consistent_iteration():
+    """A user-supplied after-iteration callback disables eval
+    pipelining: CallbackEnv.iteration must match the number of trees
+    the booster actually holds (no one-iteration lookahead skew)."""
+    import lightgbm_tpu as lgb
+    X, y = make_binary(n=800, f=5, seed=5)
+    train = lgb.Dataset(X, label=y, params=dict(TEST_PARAMS))
+    seen = []
+
+    def spy(env):
+        seen.append((env.iteration,
+                     env.model.current_iteration()))
+
+    lgb.train(dict(TEST_PARAMS, objective="binary", metric="auc",
+                   verbose=-1),
+              train, num_boost_round=6, valid_sets=[train],
+              callbacks=[spy])
+    assert len(seen) == 6
+    for it, have in seen:
+        assert have == it + 1, (it, have)

@@ -244,3 +244,27 @@ def test_device_type_routing():
             Config().set({"device_type": "banana"})
     finally:
         device.set_config_platform(None)
+
+
+def test_cv_accepts_test_index_folds():
+    """cv(folds=[test_idx, ...]) — the reference R package's custom
+    folds semantics: bare test-index arrays whose train side is the
+    complement, normalized AFTER the dataset is constructed with the
+    merged params."""
+    import lightgbm_tpu as lgb
+    X, y = make_binary(n=900, f=5, seed=31)
+    ds = lgb.Dataset(X, label=y)
+    folds = [np.arange(0, 300), np.arange(300, 600),
+             np.arange(600, 900)]
+    res = lgb.cv({"objective": "binary", "metric": "auc",
+                  "num_leaves": 15, "verbose": -1}, ds,
+                 num_boost_round=8, folds=folds, verbose_eval=False)
+    assert "auc-mean" in res and len(res["auc-mean"]) == 8
+    assert res["auc-mean"][-1] > 0.9
+    # pair form still works
+    ds2 = lgb.Dataset(X, label=y)
+    pairs = [(np.arange(300, 900), np.arange(0, 300))]
+    res2 = lgb.cv({"objective": "binary", "metric": "auc",
+                   "num_leaves": 15, "verbose": -1}, ds2,
+                  num_boost_round=5, folds=pairs, verbose_eval=False)
+    assert len(res2["auc-mean"]) == 5

@@ -524,3 +524,98 @@ class TestCountProxy:
         np.testing.assert_array_equal(leaf_f, leaf_u)
         np.testing.assert_array_equal(np.asarray(rec_f.split_feature),
                                       np.asarray(rec_u.split_feature))
+
+
+class TestPacked4:
+    """4-bit packed HBM bins (count-proxy tier): two features per byte,
+    nibble-unpack in the kernel; must grow IDENTICAL trees to the
+    unpacked uint8 tier."""
+
+    def _grow(self, packed, W=8, n=3000, F=5, fused=True):
+        from lightgbm_tpu.ops.split import FeatureMeta, SplitParams
+        from lightgbm_tpu.ops.wave_grower import (WaveGrowerConfig,
+                                                  make_wave_grower)
+        r = np.random.default_rng(21)
+        bins = r.integers(0, 16, (F, n)).astype(np.uint8)
+        gq = r.integers(-127, 128, n).astype(np.float32)
+        hq = r.integers(1, 128, n).astype(np.float32)
+        meta = FeatureMeta(
+            num_bin=np.full(F, 16, np.int32),
+            missing_type=np.zeros(F, np.int32),
+            default_bin=np.zeros(F, np.int32),
+            monotone=np.zeros(F, np.int32),
+            penalty=np.ones(F, np.float32))
+        hp = SplitParams(min_data_in_leaf=0, min_sum_hessian_in_leaf=0.0,
+                         count_lb=True)
+        cfg = WaveGrowerConfig(
+            num_leaves=15, num_bins=16, wave_size=W, hp=hp,
+            precision="int8", fused=fused, chunk=512,
+            count_proxy=True, packed4=packed)
+        grow = make_wave_grower(cfg, meta)
+        if packed:
+            b = bins if F % 2 == 0 else np.concatenate(
+                [bins, np.zeros((1, n), np.uint8)])
+            dev_bins = jnp.asarray(b[0::2] | (b[1::2] << 4))
+        else:
+            dev_bins = jnp.asarray(bins)
+        rec, leaf = grow(dev_bins, jnp.asarray(gq), jnp.asarray(hq),
+                         jnp.ones(n, jnp.float32), jnp.ones(F, bool))
+        return rec, np.asarray(leaf)
+
+    def test_packed_fused_matches_unpacked(self):
+        rec_u, leaf_u = self._grow(packed=False)
+        rec_p, leaf_p = self._grow(packed=True)
+        assert int(rec_p.num_leaves) == int(rec_u.num_leaves)
+        np.testing.assert_array_equal(leaf_p, leaf_u)
+        np.testing.assert_array_equal(np.asarray(rec_p.split_feature),
+                                      np.asarray(rec_u.split_feature))
+        np.testing.assert_array_equal(np.asarray(rec_p.split_bin),
+                                      np.asarray(rec_u.split_bin))
+        np.testing.assert_allclose(np.asarray(rec_p.leaf_output),
+                                   np.asarray(rec_u.leaf_output),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_packed_unfused_fallback_matches(self):
+        """The non-fused path unpacks up front and must agree too."""
+        rec_p, leaf_p = self._grow(packed=True, fused=True)
+        rec_q, leaf_q = self._grow(packed=True, fused=False)
+        np.testing.assert_array_equal(leaf_p, leaf_q)
+        np.testing.assert_array_equal(np.asarray(rec_p.split_feature),
+                                      np.asarray(rec_q.split_feature))
+
+    def test_gbdt_packs_and_matches_unpacked(self):
+        """End-to-end: max_bin=15 + quantized training auto-packs the
+        HBM bins (halved first axis) and trains the same model as
+        tpu_packed_bins=0."""
+        from conftest import fit_gbdt, make_binary
+        X, y = make_binary(n=1500, f=6, seed=9)
+        params = {"objective": "binary", "metric": "auc", "max_bin": 15,
+                  "tpu_quantized_hist": True}
+        gp = fit_gbdt(X, y, params, num_round=10)
+        gu = fit_gbdt(X, y, dict(params, tpu_packed_bins=0),
+                      num_round=10)
+        assert gp._grower_cfg.packed4
+        assert not gu._grower_cfg.packed4
+        assert gp._bins_dev.shape[0] == (gu._bins_dev.shape[0] + 1) // 2
+        np.testing.assert_allclose(
+            np.asarray(gp.predict_raw(X[:200])),
+            np.asarray(gu.predict_raw(X[:200])), atol=1e-6)
+
+    def test_gbdt_packed_early_stop_trim_replays_correctly(self):
+        """The early-stopping trim (and refit/continued training)
+        replay the partition on the TRAINING bins — with the 4-bit tier
+        those must be nibble-unpacked first (regression: reading packed
+        bytes as [F, N] bin codes silently corrupted scores)."""
+        from conftest import fit_gbdt, make_binary
+        X, y = make_binary(n=1500, f=6, seed=15)
+        params = {"objective": "binary", "metric": "auc", "max_bin": 15,
+                  "tpu_quantized_hist": True}
+        gp = fit_gbdt(X, y, params, num_round=10)
+        gu = fit_gbdt(X, y, dict(params, tpu_packed_bins=0),
+                      num_round=10)
+        assert gp._grower_cfg.packed4
+        gp._drop_last_iterations(3)     # replays partition on train bins
+        gu._drop_last_iterations(3)
+        np.testing.assert_allclose(
+            np.asarray(gp.predict_raw(X[:200])),
+            np.asarray(gu.predict_raw(X[:200])), atol=1e-6)
