@@ -55,6 +55,11 @@ for case in range(N_CASES):
         "feature_fraction": float(r.choice([1.0, 0.8])),
         "enable_bundle": bool(r.random() < 0.3),
         "tpu_quantized_hist": bool(r.random() < 0.3),
+        # count-proxy / 4-bit packed tiers: auto vs forced-off (they
+        # auto-engage under quant + serial/data + no-EFB/cat gates,
+        # packed additionally at max_bin <= 16)
+        "tpu_count_proxy": int(r.choice([-1, 0])),
+        "tpu_packed_bins": int(r.choice([-1, 0])),
     }
     if obj == "multiclass":
         params["num_class"] = K
