@@ -37,12 +37,20 @@ DATA = os.path.join(os.path.dirname(__file__), "data", "golden2")
 
 CASES = ["binary", "regl2", "regl1", "multic", "catbin",
          "dart", "goss", "contin", "rank", "wbin"]
+# reverse-only cases: models trained by THIS engine's approximation
+# tiers (int8 count-proxy histograms; 4-bit packed bins) — the
+# reference engine can't train these modes, but it must READ the
+# model files and reproduce our predictions (it does, to ~1e-7)
+REVERSE_ONLY = ["proxy", "pkd4"]
 
 
 def _inputs(name):
-    X = np.fromfile(os.path.join(DATA, f"g2_{name}_X.bin"),
+    # the reverse-only tier cases share one dataset (single fixture,
+    # stored under the "proxy" name)
+    src = "proxy" if name in REVERSE_ONLY else name
+    X = np.fromfile(os.path.join(DATA, f"g2_{src}_X.bin"),
                     np.float64).reshape(600, 8)
-    y = np.fromfile(os.path.join(DATA, f"g2_{name}_y.bin"), np.float32)
+    y = np.fromfile(os.path.join(DATA, f"g2_{src}_y.bin"), np.float32)
     return X, y
 
 
@@ -63,7 +71,7 @@ def test_forward_reference_model_predicts_identically(name):
         err_msg=f"{name}: reference-trained model predictions diverge")
 
 
-@pytest.mark.parametrize("name", CASES)
+@pytest.mark.parametrize("name", CASES + REVERSE_ONLY)
 def test_reverse_reference_reads_our_model_identically(name):
     X, _ = _inputs(name)
     ref_on_ours = np.fromfile(
@@ -71,7 +79,10 @@ def test_reverse_reference_reads_our_model_identically(name):
     bst = lgb.Booster(
         model_file=os.path.join(DATA, f"g2_{name}_ours_model.txt"))
     ours = np.asarray(bst.predict(X))
+    # reverse-only tier cases measured at ~9e-8 agreement when minted;
+    # assert an order of magnitude of headroom
+    atol = 1e-6 if name in REVERSE_ONLY else 1e-5
     np.testing.assert_allclose(
-        ours.reshape(-1), ref_on_ours.reshape(-1), atol=1e-5,
+        ours.reshape(-1), ref_on_ours.reshape(-1), atol=atol,
         err_msg=f"{name}: the reference engine read our model file and "
                 f"computed different predictions")
