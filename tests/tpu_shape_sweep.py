@@ -1,0 +1,74 @@
+"""Mosaic odd-shape sweep on REAL TPU hardware (NOT collected by
+pytest — run directly where a TPU is attached):
+
+    PYTHONPATH=. python tests/tpu_shape_sweep.py
+
+The CPU suite runs the Pallas kernels in interpret mode, which cannot
+vouch for per-shape MOSAIC legality (8-bit ops, sublane alignment,
+lane paddings are backend decisions). This sweep compiles and trains
+the quantized/count-proxy/4-bit-packed tiers across the shapes most
+likely to hit lowering edges: single-feature, tiny bin counts,
+odd/even feature counts under nibble packing, multiclass, sub-chunk
+row counts, bagging and GOSS sampling, and the f32-grade hi/lo tier.
+All cases ran clean on v5e (round 5)."""
+import sys
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  "/tmp/lgbm_tpu_jax_cache_dev")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+sys.path.insert(0, ".")
+
+from lightgbm_tpu.config import Config                    # noqa: E402
+from lightgbm_tpu.io.dataset import TpuDataset, Metadata  # noqa: E402
+from lightgbm_tpu.models.gbdt import GBDT                 # noqa: E402
+from lightgbm_tpu.objectives import create_objective      # noqa: E402
+
+r = np.random.default_rng(5)
+
+
+def run(tag, n, f, max_bin, obj="binary", K=1, extra=None):
+    X = r.normal(size=(n, f))
+    if obj == "binary":
+        y = (X[:, 0] > 0).astype(np.float32)
+    else:
+        y = np.clip(np.round(np.abs(X[:, 0]) * K / 2), 0, K - 1
+                    ).astype(np.float32)
+    p = {"objective": obj, "num_leaves": 15, "max_bin": max_bin,
+         "min_data_in_leaf": 2, "tpu_stop_check_interval": 10_000,
+         "tpu_quantized_hist": True}
+    if K > 1:
+        p["num_class"] = K
+    p.update(extra or {})
+    cfg = Config().set(p)
+    ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+    obj_ = create_objective(obj, cfg)
+    obj_.init(ds.metadata, ds.num_data)
+    g = GBDT()
+    g.init(cfg, ds, obj_, [])
+    for _ in range(4):
+        g.train_one_iter()
+    pred = np.asarray(g.predict_raw(X[:64]))
+    assert np.isfinite(pred).all(), tag
+    print(f"ok {tag} (proxy={g._grower_cfg.count_proxy}, "
+          f"packed={g._grower_cfg.packed4})", flush=True)
+
+
+run("F=1", 5000, 1, 63)
+run("F=3 small-N", 900, 3, 63)
+run("B=4 packed", 5000, 6, 3)
+run("B=4 unpacked", 5000, 6, 3, extra={"tpu_packed_bins": 0})
+run("multiclass K=3", 4000, 5, 63, obj="multiclass", K=3)
+run("F=29 odd + bin15 packed", 20000, 29, 15)
+run("F=2 even packed", 8000, 2, 15)
+run("F=3 odd packed", 8000, 3, 15)
+run("n<chunk", 4000, 8, 63)
+run("hilo no-quant", 20000, 8, 63,
+    extra={"tpu_quantized_hist": False})
+run("bagging+proxy", 20000, 8, 63,
+    extra={"bagging_fraction": 0.6, "bagging_freq": 1})
+run("goss+quant", 20000, 8, 63, extra={"boosting": "goss"})
+print("SWEEP OK", flush=True)
