@@ -187,20 +187,14 @@ class GBDT:
         self._learner_mode = mode
         D = mesh.devices.size if mesh is not None else 1
         # EFB rides the histogram seam (bundle columns in, member
-        # histograms out) and the meta-driven partition decode, both of
-        # which compose with the serial grower AND the row-sharded
-        # data/voting learners. Feature-parallel shards columns, which
-        # the bundle->member expansion does not slice; it trains on
-        # unbundled member columns.
+        # histograms out) and the meta-driven partition decode, which
+        # compose with the serial grower, the row-sharded data/voting
+        # learners, AND feature-parallel (where the device slice is of
+        # BUNDLE columns; each device expands its slice to its members'
+        # histograms and the election runs on the usual global argmax).
         self._use_bundles = (self.train_data.bundles is not None
-                             and mode in ("serial", "data", "voting"))
-        if self.train_data.bundles is not None and not self._use_bundles:
-            log.warning("EFB bundling is not used with "
-                        "tree_learner=feature; training on unbundled "
-                        "columns")
-            self._meta = self._meta._replace(
-                bundle=np.zeros((), np.int32),
-                offset=np.zeros((), np.int32))
+                             and mode in ("serial", "data", "voting",
+                                          "feature"))
 
         f = max(self.train_data.num_features, 1)
         self._pad_rows = 0
@@ -224,7 +218,7 @@ class GBDT:
             from ..utils.device import on_tpu
             if on_tpu():
                 self._pad_rows = (-self._n) % kchunk
-        if mode == "feature":
+        if mode == "feature" and not self._use_bundles:
             self._pad_features = (-f) % D
             if self._pad_features:
                 pad = self._pad_features
@@ -308,6 +302,7 @@ class GBDT:
             packed4=packed4)
         self._grower_cfg = gcfg
         hist_fn = None
+        efb_feature = None
         if self._use_bundles:
             # EFB: the wave kernel runs over BUNDLE columns, then member
             # histograms are reconstructed (io/efb.py docstring)
@@ -320,19 +315,26 @@ class GBDT:
             nb_m = jnp.asarray(meta.num_bin)
             db_m = jnp.asarray(meta.default_bin)
             B_out = gcfg.num_bins
-
-            def hist_fn(bins_t, g, h, leaf_ids, wave_leaves,
-                        gh_scale=None):
-                bh = wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
-                                    num_bins=Bb, chunk=gcfg.chunk,
-                                    use_pallas=gcfg.use_pallas,
-                                    precision=gcfg.precision,
-                                    gh_scale=gh_scale)
-                return expand_bundle_histogram(bh, mb, mo, nb_m, db_m,
-                                               B_out)
+            if mode == "feature":
+                # feature-parallel slices BUNDLE columns; the learner
+                # builds its own per-device slice-and-expand seam
+                efb_feature = (td.member_bundle, td.member_offset,
+                               meta.num_bin, meta.default_bin, Bb,
+                               B_out, td.bundled_bins.shape[1])
+            else:
+                def hist_fn(bins_t, g, h, leaf_ids, wave_leaves,
+                            gh_scale=None):
+                    bh = wave_histogram(bins_t, g, h, leaf_ids,
+                                        wave_leaves,
+                                        num_bins=Bb, chunk=gcfg.chunk,
+                                        use_pallas=gcfg.use_pallas,
+                                        precision=gcfg.precision,
+                                        gh_scale=gh_scale)
+                    return expand_bundle_histogram(bh, mb, mo, nb_m,
+                                                   db_m, B_out)
         self._grower = make_grower_for_mode(
             mode, gcfg, meta, mesh, self._f_pad, cfg.top_k,
-            hist_fn=hist_fn)
+            hist_fn=hist_fn, efb_feature=efb_feature)
         self._step_key = None       # grower changed: rebuild fused step
 
     def _parse_forced_splits(self) -> tuple:

@@ -347,15 +347,16 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         root_hist = hist_reduce_fn(local_root)
         F_h = root_hist.shape[1]
         if quant:
-            # root aggregates from the (dequantized) histogram itself so
-            # every later subtraction stays internally consistent. Sum
-            # the PRE-reduction local histogram and apply the scalar
-            # reducer: correct whether the mode reduces histograms
-            # (data: hist_reduce=psum, reduce=psum would double-count a
-            # post-reduction sum) or scalars only (voting: hist_reduce
-            # is identity and the local sum NEEDS the psum).
-            root_g = reduce_fn(jnp.sum(local_root[0, 0, :, 0]))
-            root_h = reduce_fn(jnp.sum(local_root[0, 0, :, 1]))
+            # root aggregates as dequantized sums of the SAME integer
+            # g/h the histogram passes consume, so later subtractions
+            # stay internally consistent — computed directly from
+            # hg/hq rather than a histogram column: a hist_fn that
+            # zero-pads unowned features (the EFB x feature-parallel
+            # seam expands only the local bundle slice) would make a
+            # column-derived sum device-dependent. Local sum then the
+            # scalar reducer: one collective in every mode.
+            root_g = reduce_fn(jnp.sum(hg)) * gh_scale[0]
+            root_h = reduce_fn(jnp.sum(hh)) * gh_scale[1]
         else:
             root_g = reduce_fn(jnp.sum(grad))
             root_h = reduce_fn(jnp.sum(hess))

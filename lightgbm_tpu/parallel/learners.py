@@ -203,6 +203,60 @@ def make_feature_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     return jax.jit(sharded)
 
 
+def make_feature_parallel_bundled_grower(cfg: WaveGrowerConfig,
+                                         meta: FeatureMeta, mesh: Mesh,
+                                         efb):
+    """Feature-parallel over EFB BUNDLE columns: every device holds all
+    rows and histograms only its slice of the bundle matrix, expands
+    that slice to its members' [W, F, B, 3] columns (zeros elsewhere),
+    finds its local best with the full-F split kernel (zero histograms
+    can never win), and the global best is the usual
+    all_gather + argmax. Closes the reference's
+    FeatureParallelTreeLearner x EFB composition without requiring the
+    bundle count to divide the device count (tail slices clamp and
+    overlap; duplicated work, identical elections)."""
+    from ..io.efb import expand_bundle_histogram
+    D = mesh.devices.size
+    mb, mo, nb_m, db_m, Bb, B_out, num_bundles = efb
+    Bd = max(1, -(-num_bundles // D))
+    mb = jnp.asarray(mb)
+    mo = jnp.asarray(mo)
+    nb_m = jnp.asarray(nb_m)
+    db_m = jnp.asarray(db_m)
+    meta_dev = FeatureMeta(*[jnp.asarray(a) for a in meta])
+
+    def hist_fn(bins_t, g, h, leaf_ids, wave_leaves, gh_scale=None):
+        i = jax.lax.axis_index(AXIS)
+        start = jnp.minimum(i * Bd,
+                            jnp.int32(max(num_bundles - Bd, 0)))
+        local = jax.lax.dynamic_slice_in_dim(bins_t, start, Bd, 0)
+        bh = wave_histogram(local, g, h, leaf_ids, wave_leaves,
+                            num_bins=Bb, chunk=cfg.chunk,
+                            use_pallas=cfg.use_pallas,
+                            precision=cfg.precision, gh_scale=gh_scale)
+        mb_loc = jnp.clip(mb - start, 0, Bd - 1)
+        owned = (mb >= start) & (mb < start + Bd)
+        full = expand_bundle_histogram(bh, mb_loc, mo, nb_m, db_m,
+                                       B_out)
+        return full * owned[None, :, None, None]
+
+    def split_fn(hists, sg, sh, nd, fmask, can):
+        res = jax.vmap(
+            lambda hh, a, b, c, d: find_best_split(
+                hh, a, b, c, fmask, meta_dev, cfg.hp, d)
+        )(hists, sg, sh, nd, can)
+        return sync_best_splits(res)
+
+    grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
+                            split_fn=split_fn, jit=False)
+    sharded = jax.shard_map(
+        grow, mesh=mesh,
+        in_specs=(P(None, None), P(None), P(None), P(None), P(None)),
+        out_specs=(P(), P()),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
 def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                 mesh: Mesh, num_features: int,
                                 top_k: int = 20, hist_fn=None):
@@ -298,16 +352,22 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
 def make_grower_for_mode(mode: str, cfg: WaveGrowerConfig,
                          meta: FeatureMeta, mesh: Optional[Mesh],
                          num_features: int, top_k: int = 20,
-                         hist_fn=None):
+                         hist_fn=None, efb_feature=None):
     """Factory matching TreeLearner::CreateTreeLearner
     (src/treelearner/tree_learner.cpp:9-33) — {serial, feature, data,
     voting} on the tpu device type. ``hist_fn`` overrides the serial
-    histogram seam (EFB bundle expansion, models/gbdt.py)."""
+    histogram seam (EFB bundle expansion, models/gbdt.py);
+    ``efb_feature`` = (member_bundle, member_offset, num_bin,
+    default_bin, B_bundle, B_out, num_bundles) routes feature-parallel
+    over bundle columns instead."""
     if mode == "serial" or mesh is None or mesh.devices.size == 1:
         return make_wave_grower(cfg, meta, hist_fn=hist_fn)
     if mode == "data":
         return make_data_parallel_grower(cfg, meta, mesh, hist_fn=hist_fn)
     if mode == "feature":
+        if efb_feature is not None:
+            return make_feature_parallel_bundled_grower(
+                cfg, meta, mesh, efb_feature)
         if hist_fn is not None:
             raise ValueError("feature-parallel does not compose with an "
                              "injected histogram seam (EFB bundles)")

@@ -136,7 +136,9 @@ class TestBundleComposition:
         assert acc_b >= acc_u - 0.005
 
     @pytest.mark.skipif(
-        len(__import__("jax").devices()) < 2, reason="needs mesh")
+        len(__import__("lightgbm_tpu.utils.device",
+                       fromlist=["get_devices"]).get_devices()) < 2,
+        reason="needs mesh")
     def test_data_parallel_with_bundles_matches_serial(self):
         """Quality parity, not bitwise: the 8-shard psum reassociates
         the expanded bundle histograms' f32 sums, and this sparse
@@ -160,7 +162,9 @@ class TestBundleComposition:
         assert acc_p >= acc_s - 0.01 and acc_p > 0.95
 
     @pytest.mark.skipif(
-        len(__import__("jax").devices()) < 2, reason="needs mesh")
+        len(__import__("lightgbm_tpu.utils.device",
+                       fromlist=["get_devices"]).get_devices()) < 2,
+        reason="needs mesh")
     def test_voting_and_quant_data_with_bundles(self):
         X, y = _sparse_problem()
         bv = self._train(X, y, tree_learner="voting", top_k=5)
@@ -176,3 +180,29 @@ class TestBundleComposition:
         # same marginal regime as the voting case above (tiny shards,
         # stochastic int8 rounding with global pmax scales)
         assert ((bq.predict(X) > 0.5) == y).mean() > 0.93
+
+    @pytest.mark.skipif(
+        len(__import__("lightgbm_tpu.utils.device",
+                       fromlist=["get_devices"]).get_devices()) < 2,
+        reason="needs mesh")
+    def test_feature_parallel_with_bundles(self):
+        """EFB composes with the feature-parallel learner: devices
+        slice BUNDLE columns, expand their slice to member histograms
+        (zeros elsewhere — zero histograms cannot win the election),
+        and the global best rides the usual all_gather+argmax. Same
+        data, same determinism: must match the serial bundled model."""
+        X, y = _sparse_problem()
+        b_ser = self._train(X, y)
+        b_fp = self._train(X, y, tree_learner="feature")
+        g = b_fp._gbdt
+        assert g._use_bundles and g._learner_mode == "feature"
+        # first split agrees; full quality parity (exact gain ties can
+        # flip with the local/global evaluation order, like the data-
+        # parallel case above)
+        gs, gf = b_ser._gbdt, b_fp._gbdt
+        gs._ensure_host_trees(); gf._ensure_host_trees()
+        assert (gs.models[0].split_feature[0]
+                == gf.models[0].split_feature[0])
+        acc_s = ((b_ser.predict(X) > 0.5) == y).mean()
+        acc_f = ((b_fp.predict(X) > 0.5) == y).mean()
+        assert acc_f >= acc_s - 0.01 and acc_f > 0.95
