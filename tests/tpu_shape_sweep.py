@@ -72,3 +72,35 @@ run("bagging+proxy", 20000, 8, 63,
     extra={"bagging_fraction": 0.6, "bagging_freq": 1})
 run("goss+quant", 20000, 8, 63, extra={"boosting": "goss"})
 print("SWEEP OK", flush=True)
+
+# EFB bundled training (non-fused pallas wave kernel over bundle
+# columns + member expansion) on real hardware, quantized and hi/lo
+def run_efb(tag, quant):
+    n, blocks = 20000, 30
+    group = r.integers(0, blocks, n)
+    X = np.zeros((n, blocks + 1))
+    X[np.arange(n), group] = r.uniform(1, 5, n)
+    X[:, blocks] = r.normal(size=n)
+    y = ((group % 7 < 3) ^ (X[:, blocks] > 0)).astype(np.float32)
+    p = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+         "min_data_in_leaf": 2, "enable_bundle": True,
+         "tpu_stop_check_interval": 10_000,
+         "tpu_quantized_hist": quant}
+    cfg = Config().set(p)
+    ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+    assert ds.bundles is not None and len(ds.bundles) < blocks
+    obj_ = create_objective("binary", cfg)
+    obj_.init(ds.metadata, ds.num_data)
+    g = GBDT()
+    g.init(cfg, ds, obj_, [])
+    assert g._use_bundles
+    for _ in range(4):
+        g.train_one_iter()
+    pred = np.asarray(g.predict_raw(X[:64]))
+    assert np.isfinite(pred).all(), tag
+    print(f"ok {tag} (bundles={len(ds.bundles)})", flush=True)
+
+
+run_efb("EFB quant", True)
+run_efb("EFB hilo", False)
+print("EFB SWEEP OK", flush=True)
