@@ -64,7 +64,6 @@ class GOSS(GBDT):
         # (goss.hpp:137-139); traced as a flag so the step doesn't
         # retrace when it switches on
         self._goss_warmup = int(1.0 / max(cfg.learning_rate, 1e-12))
-        pad_rows = self._pad_rows
 
         def hook(g_all, h_all, mask, key):
             # PRNGKey stores the seed in word 1 (word 0 is the high
@@ -80,9 +79,13 @@ class GOSS(GBDT):
             keep = (is_top | sampled).astype(jnp.float32)
             keep = jnp.where(on, keep, 1.0)
             amp = jnp.where(on, amp, 1.0)
-            if pad_rows:
+            # tail = alignment pad + any valid-set passenger rows; its
+            # mask is already zero, keep it that way (read off the
+            # traced mask shape so added valid sets retrace correctly)
+            tail = mask.shape[0] - n
+            if tail:
                 keep = jnp.concatenate(
-                    [keep, jnp.zeros(pad_rows, jnp.float32)])
+                    [keep, jnp.zeros(tail, jnp.float32)])
             g_all = g_all * amp
             h_all = h_all * amp
             return g_all, h_all, mask * keep
@@ -168,10 +171,14 @@ class DART(GBDT):
         cfg = self.config
         self._drop_index = self._select_drops()
         K = self.num_tree_per_iteration
+        if self._drop_index:
+            # hoisted: the packed4 tier's nibble-unpack is a full-
+            # matrix pass — one per drop round, not one per tree
+            tb = self._train_bins_unpacked()
         for i in self._drop_index:
             for k in range(K):
                 rec = self.records[i * K + k]
-                leaf = replay_partition(rec, self._bins_dev,
+                leaf = replay_partition(rec, tb,
                                         self._meta)[:self._n]
                 self._scores = self._scores.at[k].set(add_leaf_outputs(
                     self._scores[k], leaf, rec.leaf_output, -1.0))
@@ -191,6 +198,7 @@ class DART(GBDT):
         if not self._drop_index:
             return
         K = self.num_tree_per_iteration
+        tb = self._train_bins_unpacked()   # hoisted full-matrix unpack
         if not cfg.xgboost_dart_mode:
             keep_scale = kdrop / (kdrop + 1.0)    # final tree weight
             weight_sub = 1.0 / (kdrop + 1.0)      # dart.hpp:163
@@ -212,8 +220,7 @@ class DART(GBDT):
                             self._valid_scores[vi][k], vleaf, old_out,
                             keep_scale - 1.0))
                 # train: was subtracted fully, add back keep_scale*old
-                leaf = replay_partition(rec, self._bins_dev,
-                                        self._meta)[:self._n]
+                leaf = replay_partition(rec, tb, self._meta)[:self._n]
                 self._scores = self._scores.at[k].set(add_leaf_outputs(
                     self._scores[k], leaf, old_out, keep_scale))
                 self.records[t] = rec._replace(
@@ -268,7 +275,9 @@ class RF(GBDT):
             return self._step_fn
         grower = self._grower
         K = self.num_tree_per_iteration
-        n, pad_rows = self._n, self._pad_rows
+        n = self._n
+        pad_rows = self._n_total - n
+        valid_slices = tuple(self._valid_row_slices)
         meta = self._meta
         obj = self.objective
         L = self._grower_cfg.num_leaves
@@ -284,7 +293,7 @@ class RF(GBDT):
             renew_w = None if w is None else jnp.asarray(w, jnp.float32)
             renew_alpha = float(obj.renew_tree_output_percentile())
 
-        def step(bins, valid_bins, scores, valid_scores, mask, fmask,
+        def step(bins, scores, valid_scores, mask, fmask,
                  iter_f, init_bias, g_in, h_in, key):
             recs = []
             vs = list(valid_scores)
@@ -294,8 +303,8 @@ class RF(GBDT):
                     zpad = jnp.zeros(pad_rows, jnp.float32)
                     g_k = jnp.concatenate([g_k, zpad])
                     h_k = jnp.concatenate([h_k, zpad])
-                rec, leaf_ids = grower(bins, g_k, h_k, mask, fmask)
-                leaf_ids = leaf_ids[:n]
+                rec, leaf_full = grower(bins, g_k, h_k, mask, fmask)
+                leaf_ids = leaf_full[:n]
                 if renew:
                     # baseline is zero scores (tmp_score_, rf.hpp:146)
                     new_out = renew_leaf_outputs(
@@ -310,8 +319,8 @@ class RF(GBDT):
                 upd = (scores[k] * iter_f + rec.leaf_output[leaf_ids]) \
                     / (iter_f + 1.0)
                 scores = scores.at[k].set(jnp.where(grew, upd, scores[k]))
-                for vi in range(len(vs)):
-                    vleaf = replay_partition(rec, valid_bins[vi], meta)
+                for vi, (voff, vn) in enumerate(valid_slices):
+                    vleaf = leaf_full[voff:voff + vn]
                     vupd = (vs[vi][k] * iter_f
                             + rec.leaf_output[vleaf]) / (iter_f + 1.0)
                     vs[vi] = vs[vi].at[k].set(
@@ -319,7 +328,7 @@ class RF(GBDT):
                 recs.append(rec)
             return scores, tuple(vs), recs
 
-        self._step_fn = jax.jit(step, donate_argnums=(2, 3))
+        self._step_fn = jax.jit(step, donate_argnums=(1, 2))
         self._step_key = key_id
         return self._step_fn
 
@@ -332,14 +341,15 @@ class RF(GBDT):
         if mask_np is None:
             mask = self._full_mask_dev
         else:
-            if self._pad_rows:
+            tail = self._n_total - self._n
+            if tail:
                 mask_np = np.concatenate(
-                    [mask_np, np.zeros(self._pad_rows, np.float32)])
+                    [mask_np, np.zeros(tail, np.float32)])
             mask = jnp.asarray(mask_np)
         fmask = self._feature_mask_dev()
         step = self._get_step_fn(False)
         self._scores, new_valids, recs = step(
-            self._bins_dev, tuple(self._valid_bins_dev),
+            self._bins_dev,
             self._scores, tuple(self._valid_scores), mask, fmask,
             jnp.float32(self.iter_), self._zero_bias, self._rf_g,
             self._rf_h, self._dummy_key)
@@ -372,7 +382,7 @@ class RF(GBDT):
             self.models.pop()
             self._tree_shrinkage.pop()
             if int(rec.num_leaves) > 1:
-                leaf = replay_partition(rec, self._bins_dev,
+                leaf = replay_partition(rec, self._train_bins_unpacked(),
                                         self._meta)[:self._n]
                 self._scores = self._scores.at[k].set(
                     (self._scores[k] * it

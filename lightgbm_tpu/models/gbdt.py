@@ -123,15 +123,20 @@ class GBDT:
             # OTHER consumer of the training bins (replay_partition in
             # early-stop trimming, continued training, refit) must go
             # through _train_bins_unpacked().
-            if bins_t.shape[0] % 2:
-                bins_t = np.pad(bins_t, ((0, 1), (0, 0)))
-            bins_t = (bins_t[0::2] | (bins_t[1::2] << 4)).astype(
-                np.uint8)
+            bins_t = self._pack4_host(bins_t)
             log.info("4-bit packed bins: %.1f MB HBM "
                      "(vs %.1f MB unpacked)",
                      bins_t.nbytes / 1e6, 2 * bins_t.nbytes / 1e6)
         with timing.phase("init/upload_bins"):
+            # grower-facing matrix: train rows (+ alignment) with every
+            # valid set's rows appended as weight-0 passengers (see
+            # _rebuild_grower_bins); no valids yet at init. The train
+            # part is always the first _train_width columns — kept as
+            # a slice view, not a second resident copy.
             self._bins_dev = jnp.asarray(bins_t)
+        self._train_width = bins_t.shape[1]
+        self._valid_row_slices: List[tuple] = []
+        self._n_total = self._n + self._pad_rows
         self._full_mask_dev = jnp.asarray(np.concatenate(
             [np.ones(self._n, np.float32),
              np.zeros(self._pad_rows, np.float32)]))
@@ -423,6 +428,8 @@ class GBDT:
             self._valid_scores[-1] = self._valid_scores[-1].at[cls].set(
                 add_leaf_outputs(self._valid_scores[-1][cls], leaf,
                                  rec.leaf_output, 1.0))
+        # future iterations: this set's rows ride the wave partition
+        self._rebuild_grower_bins()
 
     def init_from_loaded(self, config: Config, train_data: TpuDataset,
                          objective: Optional[ObjectiveFunction],
@@ -478,18 +485,87 @@ class GBDT:
         self._bag_cache = mask
         return mask
 
+    @staticmethod
+    def _pack4_host(bins_t: np.ndarray) -> np.ndarray:
+        """Nibble-pack a [F, N] uint8 bin matrix (values <= 15): two
+        features per byte, even feature in the low nibble."""
+        if bins_t.shape[0] % 2:
+            bins_t = np.pad(bins_t, ((0, 1), (0, 0)))
+        return (bins_t[0::2] | (bins_t[1::2] << 4)).astype(np.uint8)
+
+    @property
+    def _bins_train_dev(self) -> jax.Array:
+        """The training columns of the grower bin matrix (valid-set
+        passenger columns excluded)."""
+        return self._bins_dev[:, :self._train_width]
+
     def _train_bins_unpacked(self) -> jax.Array:
         """Training bins as [F, N] — transient nibble-unpack when the
         4-bit packed tier is active (replay_partition and friends index
         per-feature rows; only the grower kernels understand packed
         bytes)."""
         if not self._grower_cfg.packed4:
-            return self._bins_dev
-        b = self._bins_dev
+            return self._bins_train_dev
+        b = self._bins_train_dev
         lo = jnp.bitwise_and(b, jnp.uint8(15))
         hi = jnp.right_shift(b, jnp.uint8(4))
         return jnp.stack([lo, hi], axis=1).reshape(
             -1, b.shape[1])[:self._num_bin_rows]
+
+    def _rebuild_grower_bins(self) -> None:
+        """Append every valid set's bin columns to the grower's bin
+        matrix as weight-0 passenger rows. The wave kernels then hand
+        each valid row its leaf id in the SAME fused partition pass
+        that places the training rows — the per-iteration valid-score
+        update becomes a slice + leaf-output gather. The alternative
+        (replaying num_leaves-1 splits per tree inside the step, the
+        reference's per-row traversal transliterated) measured ~2.3x
+        the whole iteration cost at 11M train + 500k valid rows;
+        passenger rows cost ~Nv/N extra kernel time instead.
+
+        Masked rows cannot influence training: their g/h/bagging mask
+        are zero, histogram counts ride the mask channel, and the
+        count-proxy's exact per-leaf counts only count in-bag rows."""
+        base = self._bins_train_dev
+        parts = [base]
+        self._valid_row_slices = []
+        off = base.shape[1]
+        for vb in self._valid_bins_dev:
+            nv = vb.shape[1]
+            if self._pad_features:
+                vb = jnp.pad(vb, ((0, self._pad_features), (0, 0)))
+            if self._grower_cfg.packed4:
+                if vb.shape[0] % 2:
+                    vb = jnp.pad(vb, ((0, 1), (0, 0)))
+                vb = jnp.bitwise_or(
+                    vb[0::2], jnp.left_shift(vb[1::2], jnp.uint8(4)))
+            self._valid_row_slices.append((off, nv))
+            parts.append(vb.astype(base.dtype))
+            off += nv
+        # re-align the combined width, mirroring the init-time row-
+        # padding policy EXACTLY: chunk alignment only where init would
+        # have applied it (serial on TPU; big data/voting shards) —
+        # small CPU/test datasets must not balloon to a 16k multiple
+        from ..utils.device import on_tpu
+        mode = self._learner_mode
+        D = self._mesh.devices.size if self._mesh is not None else 1
+        kchunk = self._grower_cfg.chunk or 8192
+        align = 1
+        if mode in ("data", "voting"):
+            align = D * kchunk if off >= 4 * D * kchunk else D
+        elif mode == "serial" and on_tpu():
+            align = kchunk
+        tail = (-off) % align
+        if tail:
+            parts.append(jnp.zeros((base.shape[0], tail), base.dtype))
+        self._n_total = off + tail
+        self._bins_dev = (parts[0] if len(parts) == 1
+                          else jnp.concatenate(parts, axis=1))
+        # masks/scores pad to the new total
+        self._full_mask_dev = jnp.concatenate(
+            [jnp.ones(self._n, jnp.float32),
+             jnp.zeros(self._n_total - self._n, jnp.float32)])
+        self._step_key = None        # step closure holds the slices
 
     def _feature_mask(self) -> np.ndarray:
         cfg = self.config
@@ -561,7 +637,9 @@ class GBDT:
         obj = self.objective
         grower = self._grower
         K = self.num_tree_per_iteration
-        n, pad_rows = self._n, self._pad_rows
+        n = self._n
+        pad_rows = self._n_total - n
+        valid_slices = tuple(self._valid_row_slices)
         meta = self._meta
         L = self._grower_cfg.num_leaves
         renew = (not custom) and obj is not None \
@@ -579,10 +657,15 @@ class GBDT:
 
         sample_hook = self._sample_hook
 
-        # bins/valid bins are ARGUMENTS, not closure constants: closed-
-        # over arrays embed into the lowered program, and at 11M rows
-        # the 308 MB constant blows the compile-RPC size limit
-        def step(bins, valid_bins, scores, valid_scores, mask, fmask,
+        # bins are an ARGUMENT, not a closure constant: closed-over
+        # arrays embed into the lowered program, and at 11M rows the
+        # 308 MB constant blows the compile-RPC size limit. Valid rows
+        # ride INSIDE ``bins`` as weight-0 passenger rows
+        # (_rebuild_grower_bins): the grower's partition hands every
+        # valid row its leaf id, so the per-iteration valid-score
+        # update is a slice + leaf-output gather instead of a
+        # num_leaves-deep split replay per tree.
+        def step(bins, scores, valid_scores, mask, fmask,
                  shrink, init_bias, g_in, h_in, key):
             if custom:
                 g_all, h_all = g_in, h_in
@@ -603,8 +686,8 @@ class GBDT:
                     zpad = jnp.zeros(pad_rows, jnp.float32)
                     g_k = jnp.concatenate([g_k, zpad])
                     h_k = jnp.concatenate([h_k, zpad])
-                rec, leaf_ids = grower(bins, g_k, h_k, mask, fmask)
-                leaf_ids = leaf_ids[:n]
+                rec, leaf_full = grower(bins, g_k, h_k, mask, fmask)
+                leaf_ids = leaf_full[:n]
                 if renew:
                     # objective-driven leaf refit
                     # (serial_tree_learner.cpp:780-818) against the
@@ -625,8 +708,8 @@ class GBDT:
                 # out-of-bag rows included: the partition covers ALL rows
                 scores = scores.at[k].set(add_leaf_outputs(
                     scores[k], leaf_ids, rec.leaf_output, 1.0))
-                for vi in range(len(vs)):
-                    vleaf = replay_partition(rec, valid_bins[vi], meta)
+                for vi, (voff, vn) in enumerate(valid_slices):
+                    vleaf = leaf_full[voff:voff + vn]
                     vs[vi] = vs[vi].at[k].set(add_leaf_outputs(
                         vs[vi][k], vleaf, rec.leaf_output, 1.0))
                 # AddBias on the STORED record only (tree.h:151): the
@@ -642,7 +725,7 @@ class GBDT:
                 recs.append(rec)
             return scores, tuple(vs), recs
 
-        self._step_fn = jax.jit(step, donate_argnums=(2, 3))
+        self._step_fn = jax.jit(step, donate_argnums=(1, 2))
         self._step_key = key
         return self._step_fn
 
@@ -678,9 +761,10 @@ class GBDT:
         if mask_np is None:
             mask = self._full_mask_dev  # precomputed padded all-ones mask
         else:
-            if self._pad_rows:
+            tail = self._n_total - self._n   # align pad + valid rows
+            if tail:
                 mask_np = np.concatenate(
-                    [mask_np, np.zeros(self._pad_rows, np.float32)])
+                    [mask_np, np.zeros(tail, np.float32)])
             mask = jnp.asarray(mask_np)
         fmask = self._feature_mask_dev()
 
@@ -694,7 +778,7 @@ class GBDT:
             key = self._dummy_key
         with timing.phase("train/step_dispatch"):
             self._scores, new_valids, recs = step(
-                self._bins_dev, tuple(self._valid_bins_dev),
+                self._bins_dev,
                 self._scores, tuple(self._valid_scores), mask, fmask,
                 jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in,
                 key)
@@ -1116,25 +1200,67 @@ class GBDT:
         start_time = time.monotonic()
         is_finished = False
 
-        def materialize(handles):
-            return {idx: ([] if entry is None else
-                          [(m.name, float(v), m.bigger_is_better)
-                           for m, v in zip(entry[0],
-                                           np.asarray(entry[1]))])
-                    for idx, entry in handles.items()}
+        def materialize_batch(batch):
+            """[(it, handles)] -> [(it, {idx: [(name, val, bigger)]})]
+            with ONE device concat and ONE download for the whole
+            batch: every np.asarray pays a full tunnel round-trip
+            (~100 ms here), so per-handle downloads re-serialize the
+            training loop no matter how the evals are pipelined."""
+            flat = [entry[1] for _, ph in batch
+                    for entry in ph.values() if entry is not None]
+            vals = (np.asarray(jnp.concatenate(flat)) if flat
+                    else np.zeros(0, np.float32))
+            out = []
+            pos = 0
+            for pit, ph in batch:
+                values = {}
+                for idx, entry in ph.items():
+                    if entry is None:
+                        values[idx] = []
+                        continue
+                    metrics = entry[0]
+                    v = vals[pos:pos + len(metrics)]
+                    pos += len(metrics)
+                    values[idx] = [
+                        (m.name, float(x), m.bigger_is_better)
+                        for m, x in zip(metrics, v)]
+                out.append((pit, values))
+            return out
 
-        # Pipelined (one-iteration lookahead) evaluation, like
-        # engine._train_loop: iteration N's device metric scalars are
-        # dispatched right after its update and MATERIALIZED while
-        # iteration N+1 trains, so per-round eval (early stopping)
-        # costs RPC latency instead of a pipeline bubble. Metric lines
-        # keep the reference format and iteration indices
-        # (gbdt.cpp:466-534); they just print one training iteration
-        # later. Falls back to the synchronous path when any metric
-        # lacks a device implementation.
+        # Pipelined evaluation with a BATCHED lookahead, like
+        # engine._train_loop but K deep: iteration N's device metric
+        # scalars are dispatched right after its update and
+        # materialized up to K training iterations later, in order. On
+        # an RPC-tunneled backend any device->host read waits behind
+        # EVERY queued dispatch (the transfer stream is ordered), so a
+        # per-iteration materialize silently re-serializes the loop to
+        # train-time + round-trip; batching K evals amortizes that
+        # drain to RTT/K per round. Semantics are unchanged: metric
+        # lines keep the reference format and indices (gbdt.cpp:466-
+        # 534, printed in small batches), and an early stop detected
+        # late pops the extra lookahead iterations (extra_drop), so
+        # the kept model is identical to the synchronous path's. Falls
+        # back to the synchronous path when any metric lacks a device
+        # implementation.
         pipeline_ok = True
-        pending = None            # (iteration index, dispatched handles)
+        pending: List[tuple] = []    # [(iteration index, handles)]
         trained = 0
+        kdepth = 16
+
+        def flush_pending():
+            """Materialize ALL queued evals (one batched download) and
+            process them in order; True = early stop fired (the extra
+            lookahead iterations are popped)."""
+            if not pending:
+                return False
+            batch = materialize_batch(pending)
+            pending.clear()
+            for pit, values in batch:
+                if self._eval_and_check_early_stopping(
+                        pit, values=values, extra_drop=trained - pit):
+                    return True
+            return False
+
         # num_iterations counts ADDITIONAL rounds on top of a loaded
         # input_model, like the reference's train loop (gbdt.cpp:248
         # iterates config num_iterations times from the loaded state);
@@ -1150,24 +1276,15 @@ class GBDT:
                 if handles is None:
                     pipeline_ok = False
                 if pipeline_ok:
-                    if pending is not None:
-                        pit, ph = pending
-                        if self._eval_and_check_early_stopping(
-                                pit, values=materialize(ph),
-                                extra_drop=it - pit):
-                            pending = None
-                            is_finished = True
-                    if not is_finished:
-                        pending = (it, handles)
+                    pending.append((it, handles))
+                    if len(pending) >= kdepth:
+                        # ONE drain per K rounds: the wait rides the
+                        # already-queued training work, costing ~one
+                        # round-trip per batch instead of per round
+                        is_finished = flush_pending()
                 else:
-                    if pending is not None:
-                        # drain the lookahead before going synchronous
-                        pit, ph = pending
-                        pending = None
-                        if self._eval_and_check_early_stopping(
-                                pit, values=materialize(ph),
-                                extra_drop=it - pit):
-                            is_finished = True
+                    # drain the lookahead before going synchronous
+                    is_finished = flush_pending()
                     if not is_finished:
                         is_finished = \
                             self._eval_and_check_early_stopping(it)
@@ -1178,12 +1295,9 @@ class GBDT:
                     f"{output_model}.snapshot_iter_{add + 1}")
             if is_finished:
                 break
-        if pending is not None:
-            # flush the final lookahead so the last iteration's metric
-            # lines (and a possible last-moment stop) are not lost
-            pit, ph = pending
-            self._eval_and_check_early_stopping(
-                pit, values=materialize(ph), extra_drop=trained - pit)
+        # flush the tail so the last iterations' metric lines (and a
+        # late-detected stop) are not lost
+        flush_pending()
         self.finish_training()
         if output_model:
             with timing.phase("io/save_model"):
