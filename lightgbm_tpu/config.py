@@ -292,6 +292,19 @@ class Config:
     # Pallas kernels unpack nibbles in VMEM — half the bin-matrix HBM,
     # double the rows/chip. -1 = auto (on when eligible); 0 = off.
     tpu_packed_bins: int = -1
+    # Pallas kernel autotuning (ops/autotune.py): "on" times a small
+    # VMEM-feasible set of tile configurations on the first encounter
+    # of a (kernel, features, bins, dtype-tier, device-kind) shape and
+    # persists the winner to the on-disk tuning cache; "off" pins the
+    # measured per-tier defaults; "exhaustive" sweeps the full
+    # candidate grid (slower first run, same cache afterwards). Tuning
+    # only ever runs on a real TPU backend.
+    tpu_autotune: str = "on"
+    # tuning-cache file path; empty = <shared cache dir>/tuning_vN.json
+    # (io/dataset.py default_cache_dir, LGBM_TPU_CACHE_DIR overridable).
+    # The file is versioned JSON: a version mismatch re-tunes instead
+    # of trusting stale entries (the dataset binary-token discipline).
+    tpu_tuning_cache: str = ""
     # write an xprof/tensorboard device trace of the training loop here
     # (engine.train wraps the loop in jax.profiler.start/stop_trace)
     tpu_profile_dir: str = ""
@@ -427,6 +440,10 @@ class Config:
                 log.warning("device_type=%s requested but "
                             "LGBM_TPU_PLATFORM=%s pins the backend",
                             dt, pin)
+        if self.tpu_autotune not in ("on", "off", "exhaustive"):
+            log.warning("tpu_autotune=%r is not one of on/off/exhaustive;"
+                        " using 'on'", self.tpu_autotune)
+            self.tpu_autotune = "on"
         if self.is_provide_training_metric or self.valid:
             if not self.metric:
                 # force defaults from objective later; handled by metric factory

@@ -14,6 +14,7 @@ trivial-feature exclusion, metadata (labels/weights/queries/init scores).
 """
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -22,6 +23,19 @@ from ..config import Config
 from ..ops.split import FeatureMeta
 from ..utils import log
 from .binning import BinMapper, BinType
+
+
+def default_cache_dir() -> str:
+    """Shared on-disk cache directory for engine artifacts that persist
+    across processes: the kernel tuning cache (ops/autotune.py) and the
+    persistent XLA compile cache live here; dataset binary files
+    (save_binary) take explicit paths but share the versioned-token
+    discipline. Overridable via LGBM_TPU_CACHE_DIR."""
+    import tempfile
+    d = os.environ.get("LGBM_TPU_CACHE_DIR") or os.path.join(
+        tempfile.gettempdir(), "lgbm_tpu_cache")
+    os.makedirs(d, exist_ok=True)
+    return d
 
 
 class Metadata:

@@ -88,6 +88,13 @@ class GBDT:
              training_metrics: Sequence[Metric] = ()):
         self.config = config
         self.train_data = train_data
+        # kernel autotuner + persistent XLA compile cache: tile choices
+        # come from the on-disk tuning cache (timed once per shape) and
+        # repeated runs skip recompilation entirely (ops/autotune.py)
+        from ..ops import autotune
+        autotune.configure(config.tpu_autotune,
+                           config.tpu_tuning_cache or None)
+        autotune.ensure_compile_cache()
         self.objective = objective
         self.training_metrics = list(training_metrics)
         self.iter_ = 0
@@ -205,13 +212,95 @@ class GBDT:
         self._pad_rows = 0
         self._pad_features = 0
         meta = self._meta
+
+        # wave size: leaves split per device step (ops/wave_grower.py);
+        # 0 = auto. Capped by the Pallas channel budget AND kept a
+        # multiple of 8: weight blocks concatenate on the sublane axis,
+        # and misaligned 25-row pieces cost ~15x in relayout shuffles
+        # (measured 1.7s vs 83ms per tree at 1M rows). hi/lo f32-grade
+        # accumulation (tpu_use_dp) needs 5W <= 128 -> W = 24; single
+        # bf16 fused needs 4W <= 128 -> W = 32.
+        quant = cfg.tpu_quantized_hist
+        # count-proxy (see config.tpu_count_proxy): int8-only, needs the
+        # fused kernel's default seams — serial/data modes, no EFB
+        # bundles, no forced splits (voting reads LOCAL count sums in
+        # its election, which proxy's global synthesis would corrupt)
+        # (categorical excluded: _categorical_tables derives right-side
+        # counts as num_data - left, which would turn the proxy's lower
+        # bounds into over-estimates)
+        proxy = (quant and mode in ("serial", "data")
+                 and not self._use_bundles
+                 and not cfg.forcedsplits_filename
+                 and not hp.has_cat
+                 and cfg.tpu_count_proxy != 0)
+        if cfg.tpu_count_proxy == 1 and not proxy:
+            log.warning("tpu_count_proxy needs tpu_quantized_hist with "
+                        "tree_learner serial/data, no EFB bundles, no "
+                        "forced splits and no categorical features; "
+                        "using exact counts")
+        if proxy and cfg.tpu_count_proxy == -1:
+            # auto-engaged (default -1): the mode changes tree structure
+            # near the min_data_in_leaf gate (per-bin counts become
+            # conservative lower bounds), so say so where a changed
+            # model can be traced back to it
+            log.info("tpu_count_proxy auto-enabled (int8 count-proxy "
+                     "histograms, 64-leaf waves): per-bin counts are "
+                     "conservative lower bounds for the "
+                     "min_data_in_leaf gate; set tpu_count_proxy=0 for "
+                     "exact counts")
+        # 4-bit packed HBM bins ride the proxy tier (see config)
+        packed4 = (proxy and self.train_data.max_bin_global <= 16
+                   and cfg.tpu_packed_bins != 0)
+        if quant and proxy:
+            precision, w_cap = "int8", 64    # 2ch (count-proxy) cap 64
+            hp = hp._replace(count_lb=True)  # conservative min_data gate
+        elif quant:
+            precision, w_cap = "int8", 40    # 3ch cap 42, 8-aligned 40
+        elif cfg.tpu_use_dp:
+            precision, w_cap = "highest", 24
+        else:
+            precision, w_cap = "default", 32
+        W = cfg.tpu_wave_size or w_cap
+        if W > w_cap:
+            log.warning("tpu_wave_size=%d exceeds the Pallas lane cap for "
+                        "this precision; clamping to %d", W, w_cap)
+        W = max(1, min(W, w_cap, max(cfg.num_leaves, 2) - 1))
+
         # effective Pallas row chunk (must match the WaveGrowerConfig
         # chunk below): rows are padded to a chunk multiple so the wave
         # kernels never re-pad the [F, N] bins — an XLA pad there is a
         # full-matrix copy per wave pass (~1 ms at the HIGGS shape,
-        # x11 passes/iter)
-        kchunk = (cfg.tpu_hist_chunk if cfg.tpu_hist_chunk > 0
-                  else 16384 if cfg.tpu_quantized_hist else 8192)
+        # x11 passes/iter). tpu_hist_chunk=0 routes the choice through
+        # the kernel autotuner (ops/autotune.py): first encounter of
+        # this (kernel, features, bins, tier, device) shape times a
+        # small VMEM-feasible candidate set and persists the winner;
+        # off-TPU the measured per-tier default is used untouched.
+        if cfg.tpu_hist_chunk > 0:
+            kchunk = cfg.tpu_hist_chunk
+        else:
+            from ..ops import autotune
+            td = self.train_data
+            bundled = self._use_bundles
+            host_bins = td.bundled_bins if bundled else td.bins
+            kchunk = autotune.tune_hist_chunk(
+                # fused-kernel eligibility mirrors wave_grower's
+                # default-seams rule: serial/data without bundles
+                fused=not bundled and mode in ("serial", "data"),
+                F=(len(td.bundles) if bundled
+                   else max(td.num_features, 1)),
+                B=(max(td.bundle_width, 2) if bundled
+                   else max(td.max_bin_global, 2)),
+                W=W, precision=precision, count_proxy=proxy,
+                packed4=packed4, any_cat=bool(hp.has_cat),
+                bins_bytes=(1 if host_bins is None
+                            or host_bins.dtype == np.uint8 else 4),
+                # per-device rows: only data/voting shard rows across
+                # the mesh (rounded UP — padding below aligns shards
+                # to a chunk multiple, and the int8 overflow filter
+                # must see the padded worst case, not floor(n/D));
+                # serial and feature-parallel kernels see every row
+                n_rows=(-(-self._n // D) if mode in ("data", "voting")
+                        else self._n))
         if mode in ("data", "voting"):
             self._pad_rows = (-self._n) % D
             if self._n >= 4 * D * kchunk:
@@ -246,48 +335,6 @@ class GBDT:
         self._n_pad = self._n + self._pad_rows
         self._f_pad = f + self._pad_features
 
-        # wave size: leaves split per device step (ops/wave_grower.py);
-        # 0 = auto. Capped by the Pallas channel budget AND kept a
-        # multiple of 8: weight blocks concatenate on the sublane axis,
-        # and misaligned 25-row pieces cost ~15x in relayout shuffles
-        # (measured 1.7s vs 83ms per tree at 1M rows). hi/lo f32-grade
-        # accumulation (tpu_use_dp) needs 5W <= 128 -> W = 24; single
-        # bf16 fused needs 4W <= 128 -> W = 32.
-        quant = cfg.tpu_quantized_hist
-        # count-proxy (see config.tpu_count_proxy): int8-only, needs the
-        # fused kernel's default seams — serial/data modes, no EFB
-        # bundles, no forced splits (voting reads LOCAL count sums in
-        # its election, which proxy's global synthesis would corrupt)
-        # (categorical excluded: _categorical_tables derives right-side
-        # counts as num_data - left, which would turn the proxy's lower
-        # bounds into over-estimates)
-        proxy = (quant and mode in ("serial", "data")
-                 and not self._use_bundles
-                 and not cfg.forcedsplits_filename
-                 and not hp.has_cat
-                 and cfg.tpu_count_proxy != 0)
-        if cfg.tpu_count_proxy == 1 and not proxy:
-            log.warning("tpu_count_proxy needs tpu_quantized_hist with "
-                        "tree_learner serial/data, no EFB bundles, no "
-                        "forced splits and no categorical features; "
-                        "using exact counts")
-        # 4-bit packed HBM bins ride the proxy tier (see config)
-        packed4 = (proxy and self.train_data.max_bin_global <= 16
-                   and cfg.tpu_packed_bins != 0)
-        if quant and proxy:
-            precision, w_cap = "int8", 64    # 2ch (count-proxy) cap 64
-            hp = hp._replace(count_lb=True)  # conservative min_data gate
-        elif quant:
-            precision, w_cap = "int8", 40    # 3ch cap 42, 8-aligned 40
-        elif cfg.tpu_use_dp:
-            precision, w_cap = "highest", 24
-        else:
-            precision, w_cap = "default", 32
-        W = cfg.tpu_wave_size or w_cap
-        if W > w_cap:
-            log.warning("tpu_wave_size=%d exceeds the Pallas lane cap for "
-                        "this precision; clamping to %d", W, w_cap)
-        W = max(1, min(W, w_cap, max(cfg.num_leaves, 2) - 1))
         gcfg = WaveGrowerConfig(
             num_leaves=max(cfg.num_leaves, 2),
             # >= 2 so the per-feature split scan is never empty (the
@@ -295,10 +342,9 @@ class GBDT:
             num_bins=max(self.train_data.max_bin_global, 2),
             wave_size=W,
             max_depth=cfg.max_depth,
-            # int8 kernels measured fastest at 16k-row chunks (the
-            # 2-channel working set leaves the VMEM headroom for it);
-            # other tiers keep the implementation default (8192).
-            # kchunk (computed above) kept in sync for row padding.
+            # autotuned row chunk (ops/autotune.py; defaults: 16384
+            # int8 / 8192 otherwise). kchunk (computed above) kept in
+            # sync for row padding.
             chunk=kchunk,
             hp=hp,
             precision=precision,
@@ -549,7 +595,8 @@ class GBDT:
         from ..utils.device import on_tpu
         mode = self._learner_mode
         D = self._mesh.devices.size if self._mesh is not None else 1
-        kchunk = self._grower_cfg.chunk or 8192
+        from ..ops.autotune import DEFAULT_HIST_CHUNK
+        kchunk = self._grower_cfg.chunk or DEFAULT_HIST_CHUNK
         align = 1
         if mode in ("data", "voting"):
             align = D * kchunk if off >= 4 * D * kchunk else D
@@ -1291,6 +1338,12 @@ class GBDT:
             log.info("%f seconds elapsed, finished iteration %d",
                      time.monotonic() - start_time, add + 1)
             if snapshot_freq > 0 and (add + 1) % snapshot_freq == 0:
+                # flush the pipelined evals BEFORE snapshotting: a
+                # late-detected early stop pops its lookahead
+                # iterations, and a snapshot written first would
+                # contain trees the pop then removes
+                if not is_finished:
+                    is_finished = flush_pending()
                 self.save_model_to_file(
                     f"{output_model}.snapshot_iter_{add + 1}")
             if is_finished:

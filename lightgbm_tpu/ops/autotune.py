@@ -1,0 +1,560 @@
+"""Kernel autotuner + persistent tuning/compile caches for the Pallas
+hot paths.
+
+The engine has two Pallas hot paths — the fused partition+histogram
+training kernel (ops/hist_wave.py) and the fused forest prediction
+kernel (ops/stacked_predict.py) — and both are tiled: rows stream
+through the training kernels in ``chunk``-row grid steps, prediction
+rows in ``row_tile`` blocks of ``tc`` trees. The best tiling depends on
+the (features, bins, dtype-tier, device) shape in exactly the way the
+reference's own tuning guide documents for its GPU kernels
+(docs/GPU-Performance.rst max_bin/workgroup trade-offs); one hardcoded
+tile cannot serve arbitrary shapes.
+
+This module is the single place that knows about tiles:
+
+1. **Shared VMEM geometry.** ``hist_geometry`` / the ``*_block_shapes``
+   functions compute the exact VMEM block shapes the kernels' BlockSpecs
+   are built from, and the ``*_vmem_bytes`` predicates price those SAME
+   shapes (double-buffering grid-indexed blocks, adding the in-kernel
+   temporaries). The kernels import their shapes from here, so the
+   VMEM-fit guards can never drift from what the kernels allocate.
+2. **The autotuner.** On first encounter of a (kernel, n_features,
+   n_bins, dtype-tier, device-kind) key, ``Autotuner.best`` times a
+   small VMEM-feasible candidate set (median-of-k wall time with a
+   device-sync readback, utils/timing.py) and persists the winner to a
+   versioned JSON cache on disk — the same versioned-token discipline
+   as the dataset binary cache (io/dataset.py BINARY_TOKEN): a version
+   mismatch re-tunes instead of trusting stale entries.
+3. **The persistent XLA compile cache.** ``ensure_compile_cache`` wires
+   jax's compilation cache (idempotent, never overriding an explicit
+   operator setting), so repeated runs skip both the tuning sweep AND
+   recompilation.
+
+Config surface: ``tpu_autotune`` (on / off / exhaustive) and
+``tpu_tuning_cache`` (cache file path; empty = the shared cache dir,
+io/dataset.py ``default_cache_dir``). Tuning only ever runs on a real
+TPU backend — CPU/interpret callers get the defaults for free.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+from typing import Callable, Dict, List, Optional
+
+from ..utils import log, timing
+
+# ---------------------------------------------------------------------------
+# Shared VMEM constants and kernel block geometry
+# ---------------------------------------------------------------------------
+
+# scoped-VMEM cap passed to every Pallas hot-path kernel (CompilerParams
+# vmem_limit_bytes): the unrolled group loops' temporaries exceed the
+# 16 MB default; v5e has 128 MB physical VMEM
+PALLAS_VMEM_LIMIT_BYTES = 100 * 1024 * 1024
+# working-set budget the tile guards/tuner admit against: headroom under
+# the limit for Mosaic's own temporaries
+PALLAS_VMEM_BUDGET_BYTES = 72 * 1024 * 1024
+
+# default tiles (the pre-autotuner hardcoded values, kept as the
+# fallback for tpu_autotune=off, CPU backends and interpret mode)
+DEFAULT_HIST_CHUNK = 8192
+DEFAULT_HIST_CHUNK_INT8 = 16384
+DEFAULT_ROW_TILE = 2048
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _nelem(shape) -> int:
+    return int(math.prod(shape))
+
+
+def hist_geometry(*, F: int, B: int, W: int, F_rows: Optional[int] = None
+                  ) -> Dict[str, int]:
+    """Histogram-kernel tile geometry shared by BOTH wave kernels and
+    the VMEM predicates: per-feature bin rows are padded to the
+    8-aligned sublane stride Bp, ``group_sz`` features share one
+    128-row matmul M-tile, and the accumulator rows pad to gb_pad.
+    ``F_rows`` is the HBM bin-matrix row count (ceil(F/2) when 4-bit
+    packed)."""
+    Bp = _round_up(B, 8)
+    group_sz = max(1, 128 // Bp)
+    gb = group_sz * Bp
+    groups = -(-F // group_sz)
+    return dict(Bp=Bp, group_sz=group_sz, gb=gb, groups=groups,
+                gb_pad=_round_up(gb, 128), wp=_round_up(W, 8),
+                F_rows=F if F_rows is None else F_rows)
+
+
+def wave_hist_block_shapes(*, chunk: int, geom: Dict[str, int]
+                           ) -> Dict[str, tuple]:
+    """VMEM block shapes of wave_histogram_pallas — the kernel's
+    BlockSpecs are built from THESE tuples."""
+    return {
+        "wl": (geom["wp"], 1),                            # f32 const
+        "bins": (geom["F_rows"], chunk),                  # grid-indexed
+        "ghl": (4, chunk),                                # grid-indexed
+        "hist": (geom["groups"], geom["gb_pad"], 128),    # accumulator
+    }
+
+
+def fused_hist_block_shapes(*, chunk: int, geom: Dict[str, int],
+                            tbl_rows: int) -> Dict[str, tuple]:
+    """VMEM block shapes of fused_partition_histogram_pallas."""
+    return {
+        "tbl": (128, tbl_rows),                           # i32 const
+        "bins": (geom["F_rows"], chunk),                  # grid-indexed
+        "ghm": (4, chunk),                                # grid-indexed
+        "leaf": (1, chunk),                               # grid-indexed
+        "hist": (geom["groups"], geom["gb_pad"], 128),    # accumulator
+        "leaf_out": (1, chunk),                           # grid-indexed
+        "cnt": (geom["wp"], 128),                         # accumulator
+    }
+
+
+def hist_vmem_bytes(*, chunk: int, geom: Dict[str, int], W: int,
+                    fused: bool, bins_bytes: int = 1, int8: bool = False,
+                    count_proxy: bool = False,
+                    tbl_rows: Optional[int] = None) -> int:
+    """Working-set bytes of one grid step of a wave-histogram kernel,
+    priced from the SAME block shapes the BlockSpecs use: grid-indexed
+    blocks double-buffered, plus the in-kernel temporaries (the
+    transposed one-hot tile, the 128-row weight matrix, one matmul
+    accumulator, and — fused — the [W, chunk] partition intermediates).
+    """
+    oh_bytes = 1 if int8 else 2                  # int8 / bf16 one-hot
+    acc_bytes = 4                                # i32 / f32 accumulator
+    if fused:
+        if tbl_rows is None:
+            # the kernel's split-table row count is the kernel's to
+            # define (lazy: hist_wave imports this module at top level)
+            from .hist_wave import TBL_ROWS
+            tbl_rows = TBL_ROWS
+        s = fused_hist_block_shapes(chunk=chunk, geom=geom,
+                                    tbl_rows=tbl_rows)
+        b = (2 * _nelem(s["bins"]) * bins_bytes
+             + 2 * _nelem(s["ghm"]) * 4
+             + 2 * _nelem(s["leaf"]) * 4
+             + 2 * _nelem(s["leaf_out"]) * 4
+             + _nelem(s["tbl"]) * 4
+             + _nelem(s["hist"]) * acc_bytes
+             + (_nelem(s["cnt"]) * 4 if count_proxy else 0))
+        # partition temporaries: cols / sentinel compares / moved, all
+        # [W, chunk] i32-grade, ~4 live at once
+        b += 4 * W * chunk * 4
+    else:
+        s = wave_hist_block_shapes(chunk=chunk, geom=geom)
+        b = (2 * _nelem(s["bins"]) * bins_bytes
+             + 2 * _nelem(s["ghl"]) * 4
+             + _nelem(s["wl"]) * 4
+             + _nelem(s["hist"]) * acc_bytes)
+    b += (geom["gb"] * chunk * oh_bytes          # one-hot tile
+          + 128 * chunk * 4                      # weight rows
+          + geom["gb_pad"] * 128 * acc_bytes)    # per-group matmul acc
+    return b
+
+
+def forest_block_shapes(*, F: int, Wtot: int, TC: int, Sp: int, Lp: int,
+                        K: int, row_tile: int) -> Dict[str, tuple]:
+    """VMEM block shapes of the fused forest prediction kernel
+    (ops/stacked_predict.py forest_predict_pallas) — its BlockSpecs are
+    built from THESE tuples, and _pallas_tc prices the same ones."""
+    return {
+        "codes": (F, row_tile),                  # i32, row-indexed
+        "W": (1, Wtot, TC * Sp),                 # i8, step-indexed
+        "P": (1, TC, Sp, Lp),                    # i8, step-indexed
+        "tgt": (1, TC, Lp),                      # i32, step-indexed
+        "leaf": (1, TC, Lp),                     # f32, step-indexed
+        "cls": (1, TC, K),                       # f32, step-indexed
+        "acc": (row_tile, K),                    # f32 accumulator
+    }
+
+
+def forest_vmem_bytes(*, F: int, Wtot: int, TC: int, Sp: int, Lp: int,
+                      K: int, row_tile: int) -> int:
+    """Working-set bytes of one fused-forest grid step: the
+    double-buffered step-indexed blocks plus the in-kernel temporaries
+    (one-hot tile [Wtot, nt] i8, C int32 + C8 int8 [nt, TC*Sp],
+    per-tree E [nt, Lp] i32)."""
+    s = forest_block_shapes(F=F, Wtot=Wtot, TC=TC, Sp=Sp, Lp=Lp, K=K,
+                            row_tile=row_tile)
+    return (2 * _nelem(s["W"])                   # int8, dbl-buffered
+            + 2 * _nelem(s["P"])                 # int8, dbl-buffered
+            + 2 * _nelem(s["tgt"]) * 4
+            + 2 * _nelem(s["leaf"]) * 4
+            + 2 * _nelem(s["cls"]) * 4
+            + 2 * _nelem(s["codes"]) * 4
+            + _nelem(s["acc"]) * 4
+            + Wtot * row_tile                    # one-hot tile (i8)
+            + row_tile * TC * Sp * 5             # C (i32) + C8 (i8)
+            + row_tile * Lp * 4)                 # per-tree E (i32)
+
+
+def fits_vmem(nbytes: int) -> bool:
+    return nbytes <= PALLAS_VMEM_BUDGET_BYTES
+
+
+def tpu_compiler_params(*, vmem_limit_bytes: int = PALLAS_VMEM_LIMIT_BYTES):
+    """Version-portable pltpu CompilerParams (renamed from
+    TPUCompilerParams after jax 0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(vmem_limit_bytes=vmem_limit_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Tuning cache (versioned JSON on disk)
+# ---------------------------------------------------------------------------
+
+TUNING_CACHE_VERSION = 1
+
+
+def default_tuning_cache_path() -> str:
+    from ..io.dataset import default_cache_dir
+    return os.path.join(default_cache_dir(),
+                        f"tuning_v{TUNING_CACHE_VERSION}.json")
+
+
+class TuningCache:
+    """{key -> {choice, timings_ms}} persisted as versioned JSON.
+
+    Likes the dataset binary cache's versioned token (io/dataset.py):
+    a file whose ``version`` field doesn't match this reader is ignored
+    wholesale (re-tune), never partially trusted. Writes are atomic
+    (tmp + rename) so concurrent trainers at worst re-tune."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._entries: Optional[Dict[str, dict]] = None
+
+    @staticmethod
+    def key_string(kernel: str, key: Dict) -> str:
+        return json.dumps({"kernel": kernel, **key}, sort_keys=True)
+
+    def _load(self) -> Dict[str, dict]:
+        if self._entries is None:
+            self._entries = {}
+            try:
+                with open(self.path) as fh:
+                    d = json.load(fh)
+                if (isinstance(d, dict)
+                        and d.get("version") == TUNING_CACHE_VERSION
+                        and isinstance(d.get("entries"), dict)):
+                    self._entries = d["entries"]
+                else:
+                    log.debug("tuning cache %s has version %r (want %d); "
+                              "ignoring it", self.path,
+                              d.get("version") if isinstance(d, dict)
+                              else None, TUNING_CACHE_VERSION)
+            except (OSError, ValueError):
+                pass
+        return self._entries
+
+    def get(self, key: str) -> Optional[dict]:
+        return self._load().get(key)
+
+    def put(self, key: str, record: dict) -> None:
+        entries = self._load()
+        entries[key] = record
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(tmp, "w") as fh:
+                json.dump({"version": TUNING_CACHE_VERSION,
+                           "entries": entries}, fh, indent=1)
+            os.replace(tmp, self.path)
+        except OSError as e:
+            log.warning("could not persist tuning cache %s: %s",
+                        self.path, e)
+
+
+# ---------------------------------------------------------------------------
+# The autotuner
+# ---------------------------------------------------------------------------
+
+class Autotuner:
+    """Times candidate tile configurations once per key, then serves the
+    winner from the on-disk cache forever."""
+
+    def __init__(self, mode: str = "on",
+                 cache_path: Optional[str] = None):
+        if mode not in ("on", "off", "exhaustive"):
+            log.warning("tpu_autotune=%r is not one of on/off/exhaustive;"
+                        " using 'on'", mode)
+            mode = "on"
+        self.mode = mode
+        self.cache = TuningCache(cache_path or default_tuning_cache_path())
+
+    def best(self, kernel: str, key: Dict, candidates: List[dict],
+             measure: Callable[[dict], float],
+             default: Optional[dict] = None) -> dict:
+        """The winning candidate for ``key``.
+
+        ``candidates``: JSON-able config dicts (already VMEM-filtered).
+        ``measure(candidate) -> seconds`` (the median-of-k repeat count
+        lives in the caller's harness, timing.measure). A cached choice
+        is only honored while it is still a member of the current
+        candidate set — a changed candidate generation (new VMEM
+        budget, new kernel rev bumping TUNING_CACHE_VERSION) re-tunes.
+        Callers whose candidate sets vary with non-key inputs must fold
+        a candidate fingerprint into ``key``, or differently-shaped
+        runs would perpetually overwrite each other's entries.
+        Candidates that fail to compile or run are skipped, not
+        fatal."""
+        if not candidates:
+            return default
+        if self.mode == "off":
+            return default if default is not None else candidates[0]
+        ck = self.cache.key_string(kernel, key)
+        hit = self.cache.get(ck)
+        if hit is not None and hit.get("choice") in candidates:
+            return hit["choice"]
+        timings_ms: Dict[str, float] = {}
+        best_c, best_t = None, float("inf")
+        with timing.phase(f"autotune/{kernel}"):
+            for cand in candidates:
+                try:
+                    t = measure(cand)
+                except Exception as e:        # noqa: BLE001 — a candidate
+                    # that Mosaic rejects must not kill training
+                    log.debug("autotune[%s]: candidate %s failed: %s",
+                              kernel, cand, e)
+                    continue
+                timings_ms[json.dumps(cand, sort_keys=True)] = round(
+                    t * 1e3, 4)
+                if t < best_t:
+                    best_c, best_t = cand, t
+        if best_c is None:
+            log.warning("autotune[%s]: every candidate failed; using the"
+                        " default %s", kernel, default)
+            return default if default is not None else candidates[0]
+        self.cache.put(ck, {"choice": best_c, "timings_ms": timings_ms})
+        log.info("autotune[%s]: chose %s (%.3f ms; %d candidates timed)",
+                 kernel, best_c, best_t * 1e3, len(timings_ms))
+        return best_c
+
+
+# module-level tuner, configured from Config (models/gbdt.py init);
+# prediction (ops/stacked_predict.py) shares whatever was last configured
+_mode = "on"
+_cache_path: Optional[str] = None
+_tuner: Optional[Autotuner] = None
+
+
+def configure(mode: str = "on", cache_path: Optional[str] = None) -> None:
+    """Install the process-wide tuning mode + cache path
+    (config.tpu_autotune / config.tpu_tuning_cache)."""
+    global _mode, _cache_path, _tuner
+    if mode != _mode or (cache_path or None) != _cache_path:
+        _mode, _cache_path = mode, (cache_path or None)
+        _tuner = None
+
+
+def tuner() -> Autotuner:
+    global _tuner
+    if _tuner is None:
+        _tuner = Autotuner(_mode, _cache_path)
+    return _tuner
+
+
+def device_kind() -> str:
+    """Cache-key device identity (e.g. 'TPU v5e' / 'cpu')."""
+    from ..utils.device import get_devices
+    d = get_devices()[0]
+    return str(getattr(d, "device_kind", None) or d.platform)
+
+
+# ---------------------------------------------------------------------------
+# Persistent XLA compile cache
+# ---------------------------------------------------------------------------
+
+_compile_cache_done = False
+
+
+def ensure_compile_cache(path: Optional[str] = None) -> None:
+    """Wire jax's persistent compilation cache so the grower/predict
+    kernels compile once per machine, not once per process (~tens of
+    seconds per distinct shape on TPU). Idempotent; an explicit
+    operator/test setting of jax_compilation_cache_dir is respected.
+
+    Auto-enabled only for the TPU backend: that is where the expensive
+    Mosaic compiles live, and this image's jax 0.4.x CPU backend
+    flakily segfaults while DESERIALIZING warm cache entries (observed
+    ~1/3 of warm-cache test runs) — a CPU process recompiles instead.
+    An operator who wants the cache on CPU sets
+    jax_compilation_cache_dir explicitly (it is respected)."""
+    global _compile_cache_done
+    if _compile_cache_done:
+        return
+    _compile_cache_done = True
+    import jax
+    try:
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            return                       # operator already configured it
+        from ..utils.device import on_tpu
+        if not on_tpu():
+            return
+        from ..io.dataset import default_cache_dir
+        jax.config.update("jax_compilation_cache_dir",
+                          path or os.path.join(default_cache_dir(), "xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception as e:               # noqa: BLE001 — the cache is an
+        # optimization; a jax without it must not break training
+        log.debug("persistent compile cache unavailable: %s", e)
+
+
+# ---------------------------------------------------------------------------
+# Histogram-kernel chunk tuning (training hot path)
+# ---------------------------------------------------------------------------
+
+def hist_chunk_candidates(*, F: int, B: int, W: int, fused: bool,
+                          bins_bytes: int = 1, int8: bool = False,
+                          count_proxy: bool = False, packed4: bool = False,
+                          n_rows: int = 0, exhaustive: bool = False
+                          ) -> List[dict]:
+    """VMEM-feasible row-chunk candidates for the wave/fused histogram
+    kernels, largest-first. Chunks beyond the dataset's rows are
+    pointless (the kernel would pad the whole matrix up); the int8 tier
+    additionally keeps the padded row count under the int32 histogram
+    overflow guard."""
+    geom = hist_geometry(F=F, B=B, W=W,
+                         F_rows=(F + 1) // 2 if packed4 else F)
+    base = ((1024, 2048, 4096, 8192, 16384, 32768, 65536) if exhaustive
+            else (4096, 8192, 16384, 32768))
+    out = []
+    for c in base:
+        if n_rows and c > max(n_rows, base[0]):
+            continue
+        if int8 and n_rows and 127 * (n_rows + (-n_rows) % c) >= 2 ** 31:
+            continue
+        if fits_vmem(hist_vmem_bytes(
+                chunk=c, geom=geom, W=W, fused=fused,
+                bins_bytes=bins_bytes, int8=int8,
+                count_proxy=count_proxy)):
+            out.append({"chunk": c})
+    return out[::-1]
+
+
+def tune_hist_chunk(*, fused: bool, F: int, B: int, W: int,
+                    precision: str = "highest", count_proxy: bool = False,
+                    packed4: bool = False, any_cat: bool = False,
+                    bins_bytes: int = 1, n_rows: int = 0) -> int:
+    """The row chunk the histogram hot path should run with — tuned on
+    first encounter of this (kernel, F, B, tier, device) key, cached
+    thereafter. Off-TPU (and with tpu_autotune=off) this returns the
+    measured per-tier default untouched."""
+    int8 = precision == "int8"
+    default = DEFAULT_HIST_CHUNK_INT8 if int8 else DEFAULT_HIST_CHUNK
+    t = tuner()
+    from ..utils.device import on_tpu
+    if t.mode == "off" or not on_tpu():
+        return default
+    cands = hist_chunk_candidates(
+        F=F, B=B, W=W, fused=fused, bins_bytes=bins_bytes, int8=int8,
+        count_proxy=count_proxy, packed4=packed4, n_rows=n_rows,
+        exhaustive=t.mode == "exhaustive")
+    if not cands:
+        return default
+    if len(cands) == 1:
+        return int(cands[0]["chunk"])
+    tier = precision + ("+proxy" if count_proxy else "") \
+        + ("+packed4" if packed4 else "")
+    key = {"F": F, "B": B, "W": W, "tier": tier, "fused": fused,
+           "cat": bool(any_cat), "bins_bytes": bins_bytes,
+           "device": device_kind(),
+           # the candidate set varies with n_rows (dataset-size cap +
+           # int8 overflow guard): folding it into the key keeps
+           # different-sized datasets from overwriting each other's
+           # entries on every alternation
+           "chunks": [c["chunk"] for c in cands]}
+    measure = _hist_measure_fn(
+        fused=fused, F=F, B=B, W=W, precision=precision,
+        count_proxy=count_proxy, packed4=packed4, any_cat=any_cat,
+        bins_bytes=bins_bytes,
+        n_meas=_hist_measure_rows(cands, F, bins_bytes))
+    choice = t.best("fused_hist" if fused else "wave_hist", key, cands,
+                    measure, default={"chunk": default})
+    return int(choice["chunk"])
+
+
+def _hist_measure_rows(cands: List[dict], F: int, bins_bytes: int) -> int:
+    """Measurement row count: a multiple of every candidate chunk,
+    capped so the synthetic bin matrix stays small."""
+    top = max(c["chunk"] for c in cands)
+    n = max(top, 65536)
+    while n > top and F * n * bins_bytes > (512 << 20):
+        n //= 2
+    return n
+
+
+def _hist_measure_fn(*, fused: bool, F: int, B: int, W: int,
+                     precision: str, count_proxy: bool, packed4: bool,
+                     any_cat: bool, bins_bytes: int, n_meas: int):
+    """Build measure(candidate) for the histogram kernels: synthetic
+    data of the real (F, B, tier) shape, one warm-up call per candidate
+    (compiles; the persistent compile cache makes reruns cheap), then
+    median-of-k wall time with a device-sync readback."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from .hist_wave import (fused_partition_histogram_pallas,
+                            wave_histogram_pallas)
+
+    rng = np.random.default_rng(0)
+    int8 = precision == "int8"
+    F_rows = (F + 1) // 2 if packed4 else F
+    bdt = np.uint8 if bins_bytes == 1 else np.int32
+    bmax = 255 if packed4 else max(B - 1, 1)
+    bins = jnp.asarray(rng.integers(0, bmax + 1, (F_rows, n_meas),
+                                    dtype=np.int64).astype(bdt))
+    if int8:
+        g = jnp.asarray(rng.integers(-127, 128, n_meas).astype(np.float32))
+        h = jnp.asarray(rng.integers(0, 128, n_meas).astype(np.float32))
+        gh_scale = (1.0, 1.0)
+    else:
+        g = jnp.asarray(rng.normal(size=n_meas).astype(np.float32))
+        h = jnp.asarray(np.abs(rng.normal(size=n_meas)).astype(np.float32))
+        gh_scale = None
+    leaf_ids = jnp.zeros(n_meas, jnp.int32)
+    if fused:
+        mask = jnp.ones(n_meas, jnp.float32)
+        # one active slot splitting leaf 0 at mid-bin — representative
+        # work (the MXU dots are dense regardless of slot activity)
+        col = np.full(W, -1, np.int32)
+        tbl = np.zeros((18, W), np.int32)
+        tbl[0] = col                     # TBL_PARENT
+        tbl[1] = col                     # TBL_NEW
+        tbl[0, 0], tbl[1, 0] = 0, 1
+        tbl[3, 0] = B // 2               # TBL_BIN
+        tbl[7] = B                       # TBL_NUMBIN
+        tbl[8] = col                     # TBL_SMALL
+        tbl[8, 0] = 1
+        tbl_d = jnp.asarray(tbl)
+
+        def run(chunk):
+            return fused_partition_histogram_pallas(
+                bins, g, h, mask, leaf_ids, tbl_d, num_bins=B,
+                chunk=chunk, precision=precision, gh_scale=gh_scale,
+                any_cat=any_cat, count_proxy=count_proxy,
+                packed4=packed4, num_features=F if packed4 else None)
+    else:
+        wl = jnp.asarray(np.concatenate(
+            [np.zeros(1, np.int32), np.full(W - 1, -1, np.int32)])
+            if W > 1 else np.zeros(1, np.int32))
+
+        def run(chunk):
+            return wave_histogram_pallas(
+                bins, g, h, leaf_ids, wl, num_bins=B, chunk=chunk,
+                precision=precision, gh_scale=gh_scale,
+                count_proxy=count_proxy, packed4=packed4,
+                num_features=F if packed4 else None)
+
+    return lambda cand: timing.measure(
+        functools.partial(run, int(cand["chunk"])))

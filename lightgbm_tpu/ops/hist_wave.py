@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import autotune
+
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
@@ -276,11 +278,11 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
         raise NotImplementedError(
             "int8 histogram sums could overflow int32 beyond ~16.9M "
             "rows; disable tpu_quantized_hist")
-    Bp = _round_up(B, 8)               # aligned per-feature row stride
-    group_sz = max(1, 128 // Bp)       # features per matmul M-tile
-    gb = group_sz * Bp
-    groups = -(-F // group_sz)
-    gb_pad = _round_up(gb, 128)
+    # tile geometry + block shapes from the shared source of truth the
+    # autotuner's VMEM predicate prices (ops/autotune.py)
+    geom = autotune.hist_geometry(F=F, B=B, W=W, F_rows=bins_t.shape[0])
+    group_sz, gb = geom["group_sz"], geom["gb"]
+    groups, gb_pad = geom["groups"], geom["gb_pad"]
 
     pad = (-n) % chunk
     if pad:
@@ -294,7 +296,7 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
         g.astype(jnp.float32), h.astype(jnp.float32),
         leaf_ids.astype(jnp.float32), jnp.zeros_like(g, jnp.float32)],
         axis=0)                                          # [4, N]
-    wp = _round_up(W, 8)
+    wp = geom["wp"]
     wl = wave_leaves.astype(jnp.float32)[:, None]        # [W, 1]
     if wp != W:
         wl = jnp.pad(wl, ((0, wp - W), (0, 0)), constant_values=-1.0)
@@ -304,33 +306,32 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
         group_sz=group_sz, hilo=hilo, exact_dot=interpret and not int8,
         int8=int8, count_proxy=count_proxy, packed4=packed4)
 
-    F_rows = bins_t.shape[0]         # packed4: ceil(F/2) byte rows
+    blk = autotune.wave_hist_block_shapes(chunk=chunk, geom=geom)
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // chunk,),
         in_specs=[
-            pl.BlockSpec((wp, 1), lambda i: (0, 0),
+            pl.BlockSpec(blk["wl"], lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((F_rows, chunk), lambda i: (0, i),
+            pl.BlockSpec(blk["bins"], lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((4, chunk), lambda i: (0, i),
+            pl.BlockSpec(blk["ghl"], lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((groups, gb_pad, 128), lambda i: (0, 0, 0),
+        out_specs=pl.BlockSpec(blk["hist"], lambda i: (0, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (groups, gb_pad, 128), jnp.int32 if int8 else jnp.float32),
+            blk["hist"], jnp.int32 if int8 else jnp.float32),
         # the unrolled group loop's temporaries exceed the 16 MB default
         # scoped-vmem cap; v5e has 128 MB physical VMEM
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
+        compiler_params=autotune.tpu_compiler_params(),
         interpret=interpret,
     )(wl, bins_t, ghl)
 
     # [groups, gb_pad, 128] -> [F, B, ncol] -> [W, F, B, 3]
     # (feature rows sit at the aligned Bp stride; slice back to B)
     out = out[:, :gb, :ncol].reshape(
-        groups * group_sz, Bp, ncol)[:F, :B]
+        groups * group_sz, geom["Bp"], ncol)[:F, :B]
     if hilo:
         out = out.reshape(F, B, 5, W)
         out = jnp.stack([out[:, :, 0] + out[:, :, 1],     # g = hi + lo
@@ -371,7 +372,8 @@ def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     if use_pallas:
         return wave_histogram_pallas(
             bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
-            chunk=chunk or 8192, precision=precision, gh_scale=gh_scale,
+            chunk=chunk or autotune.DEFAULT_HIST_CHUNK,
+            precision=precision, gh_scale=gh_scale,
             count_proxy=count_proxy)
     out = wave_histogram_xla(
         bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
@@ -695,11 +697,11 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
             "int8 histogram sums could overflow int32 beyond ~16.9M "
             "rows; disable tpu_quantized_hist")
     nchan = (2 if count_proxy else 3) if int8 else 5 if hilo else 4
-    Bp = _round_up(B, 8)
-    group_sz = max(1, 128 // Bp)
-    gb = group_sz * Bp
-    groups = -(-F // group_sz)
-    gb_pad = _round_up(gb, 128)
+    # tile geometry + block shapes from the shared source of truth the
+    # autotuner's VMEM predicate prices (ops/autotune.py)
+    geom = autotune.hist_geometry(F=F, B=B, W=W, F_rows=bins_t.shape[0])
+    Bp, group_sz, gb = geom["Bp"], geom["group_sz"], geom["gb"]
+    groups, gb_pad = geom["groups"], geom["gb_pad"]
 
     pad = (-n) % chunk
     if pad:
@@ -724,41 +726,40 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
         _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz,
         hilo=hilo, exact_dot=interpret and not int8, int8=int8,
         any_cat=any_cat, count_proxy=count_proxy, packed4=packed4)
-    F_rows = bins_t.shape[0]         # packed4: ceil(F/2) byte rows
 
-    wp = _round_up(W, 8)
+    blk = autotune.fused_hist_block_shapes(chunk=chunk, geom=geom,
+                                           tbl_rows=TBL_ROWS)
     out_specs = [
-        pl.BlockSpec((groups, gb_pad, 128), lambda i: (0, 0, 0),
+        pl.BlockSpec(blk["hist"], lambda i: (0, 0, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, chunk), lambda i: (0, i),
+        pl.BlockSpec(blk["leaf_out"], lambda i: (0, i),
                      memory_space=pltpu.VMEM),
     ]
     out_shape = [
-        jax.ShapeDtypeStruct((groups, gb_pad, 128),
+        jax.ShapeDtypeStruct(blk["hist"],
                              jnp.int32 if int8 else jnp.float32),
         jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
     ]
     if count_proxy:
-        out_specs.append(pl.BlockSpec((wp, 128), lambda i: (0, 0),
+        out_specs.append(pl.BlockSpec(blk["cnt"], lambda i: (0, 0),
                                       memory_space=pltpu.VMEM))
-        out_shape.append(jax.ShapeDtypeStruct((wp, 128), jnp.float32))
+        out_shape.append(jax.ShapeDtypeStruct(blk["cnt"], jnp.float32))
     outs = pl.pallas_call(
         kernel,
         grid=(n_pad // chunk,),
         in_specs=[
-            pl.BlockSpec((128, TBL_ROWS), lambda i: (0, 0),
+            pl.BlockSpec(blk["tbl"], lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((F_rows, chunk), lambda i: (0, i),
+            pl.BlockSpec(blk["bins"], lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((4, chunk), lambda i: (0, i),
+            pl.BlockSpec(blk["ghm"], lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, chunk), lambda i: (0, i),
+            pl.BlockSpec(blk["leaf"], lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shape),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
+        compiler_params=autotune.tpu_compiler_params(),
         interpret=interpret,
     )(tblT, bins_t, ghm, leaf2d)
     hist, leaf_out = outs[0], outs[1]

@@ -45,8 +45,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import autotune
 from ..io.binning import MissingType
-from ..utils import log
+from ..utils import log, timing
 
 # decision_type bit layout (models/tree.py, mirroring tree.h)
 K_CATEGORICAL_MASK = 1
@@ -59,9 +60,10 @@ MAX_FEATURE_WIDTH = 1024
 TREE_CHUNK = 16    # trees per scan/grid step (TC=16 measured ~10%
                    # faster than 8 at the 500-tree bench shape; wide
                    # models drop TC until the kernel blocks fit VMEM)
-# fused-kernel working-set budget: stay under the 100 MB
-# vmem_limit_bytes with headroom for Mosaic's own temporaries
-_PALLAS_VMEM_BUDGET = 72 * 1024 * 1024
+# fused-kernel working-set budget (shared with the autotuner, which
+# prices the SAME block shapes the kernel's BlockSpecs are built from:
+# ops/autotune.py forest_block_shapes / forest_vmem_bytes)
+_PALLAS_VMEM_BUDGET = autotune.PALLAS_VMEM_BUDGET_BYTES
 
 
 class StackedModel:
@@ -337,25 +339,31 @@ class StackedModel:
         intermediate C matrix stays reasonable."""
         return TREE_CHUNK if self._Wtot <= 4096 else TREE_CHUNK // 2
 
-    def _pallas_tc(self, row_tile: int = 2048) -> Optional[int]:
+    def _pallas_tc(self, row_tile: int = autotune.DEFAULT_ROW_TILE
+                   ) -> Optional[int]:
         """Trees per grid step for the fused forest kernel, sized from
         the kernel's ACTUAL VMEM blocks (not just Wtot): the
         double-buffered W ([Wtot, TC*Sp] int8) and P ([TC, Sp, Lp] int8)
         inputs plus the in-kernel C/one-hot temporaries all scale with
         TC and the 128-padded S/L, so a large-num_leaves model can blow
-        the budget at a modest Wtot. Returns None when even TC=1 does
-        not fit — predict() then routes to the XLA scan path instead of
-        tripping a Mosaic compile error on device."""
+        the budget at a modest Wtot. The byte estimate is
+        autotune.forest_vmem_bytes — priced from the SAME block shapes
+        forest_predict_pallas builds its BlockSpecs from. Returns None
+        when even TC=1 does not fit — predict() then routes to the XLA
+        scan path instead of tripping a Mosaic compile error on
+        device."""
         Sp = -(-self._S // 128) * 128
         Lp = -(-self._L // 128) * 128
+        # K/F default for skeleton callers (tests size the guard with
+        # only _S/_L/_Wtot set); both terms are minor
+        K = max(getattr(self, "num_class", 1), 1)
+        offs = getattr(self, "_offsets", None)
+        F = max(len(offs) - 1, 0) if offs is not None else 0
         tc = TREE_CHUNK
         while tc >= 1:
-            est = (2 * self._Wtot * tc * Sp      # W blocks (dbl-buffered)
-                   + 2 * tc * Sp * Lp            # P blocks (dbl-buffered)
-                   + row_tile * tc * Sp * 4      # C (int32)
-                   + row_tile * tc * Sp          # C8
-                   + row_tile * self._Wtot       # one-hot tile
-                   + row_tile * Lp * 4)          # per-tree E
+            est = autotune.forest_vmem_bytes(
+                F=F, Wtot=self._Wtot, TC=tc, Sp=Sp, Lp=Lp, K=K,
+                row_tile=row_tile)
             if est <= _PALLAS_VMEM_BUDGET:
                 return tc
             tc //= 2
@@ -403,6 +411,17 @@ class StackedModel:
         # returns None for models that cannot fit at all — those use
         # the XLA scan path instead of crashing the fused kernel.
         tc = self._pallas_tc() if forest else None
+        row_tile = autotune.DEFAULT_ROW_TILE
+        if forest and tc is None:
+            # the default row tile can miss the VMEM budget where a
+            # smaller one fits (row_tile-scaled blocks dominating at
+            # large Wtot/Sp) — try the smaller candidate tiles before
+            # surrendering to the XLA scan path
+            for rt in (1024, 512):
+                tc = self._pallas_tc(rt)
+                if tc is not None:
+                    row_tile = rt
+                    break
         forest = forest and tc is not None
         if forest and not pred_leaf:
             # fused forest kernel, dispatched per ROW CHUNK: every
@@ -412,9 +431,11 @@ class StackedModel:
             # otherwise serializes after the math. f32 on the wire
             # (f64 only at this API boundary, predictor.hpp-style)
             # halves the download.
+            interp = not on_tpu()
+            row_tile, tc = self._tuned_tiles(first, ntree, row_tile,
+                                             tc, interp)
             dev = self._device_arrays_pallas(first, ntree, tc)
             offs = tuple(int(o) for o in self._offsets)
-            interp = not on_tpu()
             fchunk = 1 << 18
             handles = []
             for c0 in range(0, N, fchunk):
@@ -432,12 +453,14 @@ class StackedModel:
                         jnp.asarray(part), jnp.asarray(self._E_f32),
                         jnp.asarray(self._off32),
                         jnp.asarray(self._nan_slot), *dev,
-                        offsets=offs, interpret=interp)
+                        offsets=offs, row_tile=row_tile,
+                        interpret=interp)
                 else:
                     codes_t = jnp.asarray(
                         np.ascontiguousarray(part.T))
                     h = forest_predict_pallas(
-                        codes_t, *dev, offsets=offs, interpret=interp)
+                        codes_t, *dev, offsets=offs,
+                        row_tile=row_tile, interpret=interp)
                 handles.append((h, nrows))
             acc = np.concatenate(
                 [np.asarray(h)[:nr] for h, nr in handles], axis=0)
@@ -480,6 +503,70 @@ class StackedModel:
         Lp = -(-self._L // 128) * 128
         return self._stack_range(("pallas", first, ntree, tc), first,
                                  ntree, Sp, Lp, np.int32, tc)
+
+    def _tuned_tiles(self, first: int, ntree: int, rt_default: int,
+                     tc_default: int, interp: bool):
+        """(row_tile, tc) for the fused forest kernel — autotuned on
+        first encounter of this model-shape key (ops/autotune.py),
+        cached on disk thereafter. The key is the kernel's SHAPE — the
+        exact table width Wtot (already a sum of 32-bucketed
+        per-feature widths, so retrained models of one pipeline
+        usually land on the same value), padded S/L, classes, device
+        kind — not the tree count: timing scales uniformly in the step
+        count, so the ranking measured on the first model of a shape
+        serves all of them. A cached choice is applied only when it is
+        in THIS model's freshly computed candidate set, so an entry
+        from a near-miss shape can never install a tc that does not
+        fit. Off-TPU and with tpu_autotune=off the measured default
+        tile is used untouched."""
+        t = autotune.tuner()
+        if interp or t.mode == "off":
+            return rt_default, tc_default
+        tiles = ((512, 1024, 2048, 4096, 8192)
+                 if t.mode == "exhaustive" else (1024, 2048, 4096))
+        cands = []
+        for rt in tiles:
+            tc = self._pallas_tc(rt)
+            if tc is not None:
+                cands.append({"row_tile": rt, "tc": tc})
+        if not cands:
+            return rt_default, tc_default
+        Sp = -(-self._S // 128) * 128
+        Lp = -(-self._L // 128) * 128
+        key = {"Wtot": self._Wtot, "Sp": Sp, "Lp": Lp,
+               "K": self.num_class, "F": len(self._offsets) - 1,
+               "device": autotune.device_kind(),
+               # candidate fingerprint (Autotuner.best contract): the
+               # feasible (row_tile, tc) set varies with the tuning
+               # mode and model geometry, and on/exhaustive runs must
+               # not thrash or shadow each other's entries
+               "tiles": [[c["row_tile"], c["tc"]] for c in cands]}
+        offs = tuple(int(o) for o in self._offsets)
+        # a multiple of every tile, several steps above the largest
+        # one: a max(tiles)-row dispatch would amortize fixed per-
+        # dispatch overhead over ONE grid step for the biggest tile
+        # but several for the small ones, biasing the ranking toward
+        # big tiles relative to the real 2^18-row predict chunks
+        n_meas = min(8 * max(tiles), 1 << 18)
+        codes = jnp.zeros((len(offs) - 1, n_meas), jnp.int32)
+
+        def measure(cand):
+            dev = self._device_arrays_pallas(first, ntree, cand["tc"])
+            return timing.measure(
+                lambda: forest_predict_pallas(
+                    codes, *dev, offsets=offs,
+                    row_tile=cand["row_tile"], interpret=False))
+
+        choice = t.best(
+            "forest", key, cands, measure,
+            default={"row_tile": rt_default, "tc": tc_default})
+        rt, tc = int(choice["row_tile"]), int(choice["tc"])
+        # losing candidates' device stacks would otherwise sit in the
+        # (bounded) _dev_cache; keep only the winner's
+        for k in [k for k in self._dev_cache
+                  if k[0] == "pallas" and k[3] != tc]:
+            self._dev_cache.pop(k, None)
+        return rt, tc
 
 
 class _FallbackError(Exception):
@@ -524,13 +611,17 @@ def _codes_from_x(x, E, off32, nan_slot):
     return codes.T
 
 
-@functools.partial(jax.jit, static_argnames=("offsets", "interpret"))
+@functools.partial(jax.jit, static_argnames=("offsets", "row_tile",
+                                             "interpret"))
 def forest_predict_from_x(x, E, off32, nan_slot, W, P, tgt, leaf, cls,
-                          *, offsets, interpret=False):
+                          *, offsets,
+                          row_tile=autotune.DEFAULT_ROW_TILE,
+                          interpret=False):
     """Device binning + forest kernel in ONE dispatch."""
     codes_t = _codes_from_x(x, E, off32, nan_slot)
     return forest_predict_pallas(codes_t, W, P, tgt, leaf, cls,
-                                 offsets=offsets, interpret=interpret)
+                                 offsets=offsets, row_tile=row_tile,
+                                 interpret=interpret)
 
 
 def _f32_exact(X64: np.ndarray, X32: np.ndarray) -> bool:
@@ -665,8 +756,13 @@ def _forest_kernel(codes_ref, W_ref, P_ref, tgt_ref, leaf_ref, cls_ref,
 @functools.partial(jax.jit, static_argnames=("offsets", "row_tile",
                                              "interpret"))
 def forest_predict_pallas(codes_t, W, P, tgt, leaf, cls, *, offsets,
-                          row_tile=2048, interpret=False):
-    """codes_t [F, N] int32 -> scores [N, K] f32, one fused dispatch."""
+                          row_tile=autotune.DEFAULT_ROW_TILE,
+                          interpret=False):
+    """codes_t [F, N] int32 -> scores [N, K] f32, one fused dispatch.
+
+    BlockSpecs come from autotune.forest_block_shapes — the same tuples
+    _pallas_tc's VMEM estimate prices, so guard and kernel cannot
+    drift."""
     F, N = codes_t.shape
     steps, Wtot, TCSp = W.shape
     _, TC, Sp, Lp = P.shape
@@ -679,28 +775,29 @@ def forest_predict_pallas(codes_t, W, P, tgt, leaf, cls, *, offsets,
     kernel = functools.partial(
         _forest_kernel, F=F, Wtot=Wtot, offs=tuple(offsets), TC=TC,
         Sp=Sp, Lp=Lp, K=K, nt=row_tile)
+    blk = autotune.forest_block_shapes(F=F, Wtot=Wtot, TC=TC, Sp=Sp,
+                                       Lp=Lp, K=K, row_tile=row_tile)
     acc = pl.pallas_call(
         kernel,
         grid=(n_pad // row_tile, steps),
         in_specs=[
-            pl.BlockSpec((F, row_tile), lambda r, t: (0, r),
+            pl.BlockSpec(blk["codes"], lambda r, t: (0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, Wtot, TCSp), lambda r, t: (t, 0, 0),
+            pl.BlockSpec(blk["W"], lambda r, t: (t, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, TC, Sp, Lp), lambda r, t: (t, 0, 0, 0),
+            pl.BlockSpec(blk["P"], lambda r, t: (t, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, TC, Lp), lambda r, t: (t, 0, 0),
+            pl.BlockSpec(blk["tgt"], lambda r, t: (t, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, TC, Lp), lambda r, t: (t, 0, 0),
+            pl.BlockSpec(blk["leaf"], lambda r, t: (t, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, TC, K), lambda r, t: (t, 0, 0),
+            pl.BlockSpec(blk["cls"], lambda r, t: (t, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((row_tile, K), lambda r, t: (r, 0),
+        out_specs=pl.BlockSpec(blk["acc"], lambda r, t: (r, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_pad, K), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
+        compiler_params=autotune.tpu_compiler_params(),
         interpret=interpret,
     )(codes_t, W, P, tgt, leaf, cls)
     return acc[:N]

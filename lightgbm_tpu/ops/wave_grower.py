@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .autotune import DEFAULT_HIST_CHUNK
 from .grower import TreeRecord
 from .hist_wave import (fused_partition_histogram_pallas, wave_histogram)
 from .partition import member_column, row_goes_right
@@ -336,7 +337,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             from .hist_wave import wave_histogram_pallas
             local_root = wave_histogram_pallas(
                 bins_t, hg, hh, bag_mask_ids(leaf0), root_wl,
-                num_bins=B, chunk=cfg.chunk or 8192,
+                num_bins=B, chunk=cfg.chunk or DEFAULT_HIST_CHUNK,
                 interpret=fused_interpret, precision=cfg.precision,
                 gh_scale=gh_scale, count_proxy=True,
                 packed4=cfg.packed4,
@@ -354,9 +355,24 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             # zero-pads unowned features (the EFB x feature-parallel
             # seam expands only the local bundle slice) would make a
             # column-derived sum device-dependent. Local sum then the
-            # scalar reducer: one collective in every mode.
-            root_g = reduce_fn(jnp.sum(hg)) * gh_scale[0]
-            root_h = reduce_fn(jnp.sum(hh)) * gh_scale[1]
+            # scalar reducer: one collective in every mode. The LOCAL
+            # sum accumulates in int32 when the shard's row count
+            # provably cannot wrap it (|v| <= 127 so the total is
+            # bounded by 127*n < 2^31 — the same bound the Pallas
+            # kernels' overflow guard enforces; the XLA fallback path
+            # has no such guard, so bigger shards keep the old f32
+            # sum, which rounds but never wraps). The exact per-shard
+            # total converts to f32 BEFORE the reducer: an int32 psum
+            # across D shards could wrap even when every shard is
+            # within bound, while the f32 psum of D already-exact
+            # totals rounds only D-1 additions.
+            if 127 * n < 2 ** 31:
+                def acc(v):
+                    return jnp.sum(v.astype(jnp.int32)).astype(f32)
+            else:
+                acc = jnp.sum
+            root_g = reduce_fn(acc(hg)) * gh_scale[0]
+            root_h = reduce_fn(acc(hh)) * gh_scale[1]
         else:
             root_g = reduce_fn(jnp.sum(grad))
             root_h = reduce_fn(jnp.sum(hess))
@@ -467,7 +483,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 fused_out = fused_partition_histogram_pallas(
                     bins_t, hg, hh, sample_mask,
                     state.leaf_ids, tbl, num_bins=B,
-                    chunk=cfg.chunk or 8192, interpret=fused_interpret,
+                    chunk=cfg.chunk or DEFAULT_HIST_CHUNK,
+                    interpret=fused_interpret,
                     precision=cfg.precision, gh_scale=gh_scale,
                     any_cat=bool(hp.has_cat), count_proxy=proxy,
                     packed4=cfg.packed4,

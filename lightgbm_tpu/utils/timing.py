@@ -42,6 +42,45 @@ def reset() -> None:
     _counts.clear()
 
 
+def seconds(prefix: str) -> float:
+    """Total accumulated seconds of every phase whose name starts with
+    ``prefix`` (e.g. "autotune" sums all per-kernel tuning phases)."""
+    return sum(v for k, v in _acc.items() if k.startswith(prefix))
+
+
+def _sync(out) -> None:
+    """Force completion of a dispatched jax computation with a real
+    device->host scalar readback: block_until_ready alone has been
+    observed returning early on RPC-tunneled backends (bench.py), and
+    the transfer stream is ordered, so one scalar drains the queue."""
+    import numpy as np
+    try:
+        import jax
+        leaves = [x for x in jax.tree_util.tree_leaves(out)
+                  if hasattr(x, "dtype")]
+    except ImportError:
+        leaves = []
+    if leaves:
+        x = leaves[0]
+        np.asarray(x.ravel()[:1] if getattr(x, "ndim", 0) else x)
+
+
+def measure(fn, *args, repeats: int = 5, warmup: int = 1) -> float:
+    """Median-of-``repeats`` wall seconds of ``fn(*args)`` with a device
+    sync per call — the autotuner's measurement harness (the reference
+    times its GPU kernel variants the same way, docs/GPU-Performance).
+    ``warmup`` untimed calls absorb compilation."""
+    for _ in range(max(warmup, 0)):
+        _sync(fn(*args))
+    times = []
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        _sync(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
 def report() -> str:
     """One line per phase: total seconds, calls, mean ms."""
     lines = []

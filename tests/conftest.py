@@ -14,16 +14,39 @@ import os
 # (lightgbm_tpu/utils/device.py) and jax_default_device keeps all test
 # computation off the tunnel.
 os.environ["LGBM_TPU_PLATFORM"] = "cpu"
+# jax < 0.5 has no jax_num_cpu_devices config option and needs the XLA
+# flag set BEFORE jax imports; jax >= 0.5 wants the config option and
+# rejects having both. Pick ONE mechanism by version, read without
+# importing jax (the flag must precede the import).
+from importlib import metadata as _md  # noqa: E402
+
+try:
+    _legacy_jax = tuple(
+        int(x) for x in _md.version("jax").split(".")[:2]) < (0, 5)
+except Exception:                       # unparseable dev version
+    _legacy_jax = False
+if _legacy_jax and "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8 "
+        + os.environ.get("XLA_FLAGS", ""))
 
 import jax  # noqa: E402
 
-jax.config.update("jax_num_cpu_devices", 8)
+if not _legacy_jax:
+    jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_default_device",
                   jax.local_devices(backend="cpu")[0])
 # Persistent compile cache: distinct grower shapes compile once per
-# machine, not once per pytest run.
-jax.config.update("jax_compilation_cache_dir", "/tmp/lgbm_tpu_jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# machine, not once per pytest run. Disabled on jax 0.4.x: its CPU
+# cache-deserialization path flakily segfaults/aborts when serving a
+# warm entry (~1/3 of warm runs in this image), killing the whole
+# pytest process; recompiling is slower but deterministic. Set
+# LGBM_TPU_TEST_COMPILE_CACHE=1 to opt back in on a fixed jax.
+if os.environ.get("LGBM_TPU_TEST_COMPILE_CACHE"):
+    jax.config.update("jax_compilation_cache_dir",
+                      "/tmp/lgbm_tpu_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -23,6 +23,28 @@ def _sparse_problem(n=2000, blocks=40, seed=0):
     return X, y
 
 
+def _tiefree_sparse_problem(n=2000, blocks=24, seed=5):
+    """Exclusive one-hot block (bundles) + two dense continuous
+    features with well-separated smooth signal. Unlike
+    _sparse_problem's modular-arithmetic label (which produces EXACT
+    gain ties whose winner depends on summation order), every
+    candidate split's gain here is a distinct continuous value, so the
+    data-parallel psum's f32 reassociation cannot flip an election —
+    near-exact serial/parallel parity is expected."""
+    rng = np.random.default_rng(seed)
+    group = rng.integers(0, blocks, n)
+    X = np.zeros((n, blocks + 2))
+    X[np.arange(n), group] = rng.uniform(1, 5, n)
+    X[:, blocks] = rng.normal(size=n)
+    X[:, blocks + 1] = rng.normal(size=n)
+    w = rng.uniform(0.5, 2.0, blocks) * np.where(
+        rng.random(blocks) < 0.5, -1, 1)
+    logit = (w[group] * 0.8 + 1.7 * X[:, blocks]
+             - 0.9 * X[:, blocks + 1] + 0.25 * rng.normal(size=n))
+    y = (logit > 0).astype(np.float64)
+    return X, y
+
+
 class TestBundling:
     def test_find_bundles_merges_exclusive(self):
         rng = np.random.default_rng(1)
@@ -160,6 +182,30 @@ class TestBundleComposition:
         acc_s = ((b_ser.predict(X) > 0.5) == y).mean()
         acc_p = ((b_par.predict(X) > 0.5) == y).mean()
         assert acc_p >= acc_s - 0.01 and acc_p > 0.95
+
+    @pytest.mark.skipif(
+        len(__import__("lightgbm_tpu.utils.device",
+                       fromlist=["get_devices"]).get_devices()) < 2,
+        reason="needs mesh")
+    def test_data_parallel_efb_split_sequences_match_serial(self):
+        """Beyond the first-split check: on a TIE-FREE problem the
+        full per-tree split_feature sequences of the data-parallel
+        bundled learner match the serial bundled learner exactly —
+        the 8-shard psum over expanded bundle histograms reassociates
+        f32 sums, but with every gain a distinct continuous value that
+        reassociation cannot change any election. (The looser
+        test_data_parallel_with_bundles_matches_serial keeps covering
+        the tie-carrying problem, where only quality parity holds.)"""
+        X, y = _tiefree_sparse_problem()
+        b_ser = self._train(X, y)
+        b_par = self._train(X, y, tree_learner="data")
+        gs, gp = b_ser._gbdt, b_par._gbdt
+        assert gp._use_bundles and gp._learner_mode == "data"
+        gs._ensure_host_trees(); gp._ensure_host_trees()
+        assert len(gs.models) == len(gp.models) > 0
+        for t, (ts, tp) in enumerate(zip(gs.models, gp.models)):
+            assert list(ts.split_feature) == list(tp.split_feature), \
+                f"tree {t} split sequence diverged"
 
     @pytest.mark.skipif(
         len(__import__("lightgbm_tpu.utils.device",
