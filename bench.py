@@ -62,6 +62,9 @@ def main():
     ap.add_argument("--no-quant", action="store_true",
                     help="disable int8 histogram quantization "
                          "(f32-grade hi/lo accumulation instead)")
+    ap.add_argument("--no-ingest", action="store_true",
+                    help="disable the streamed device ingest path "
+                         "(host binner + bulk upload instead)")
     ap.add_argument("--learner", default="serial",
                     choices=["serial", "data", "voting"],
                     help="tree learner: 'data' shards rows over every "
@@ -104,6 +107,9 @@ def main():
         # printed below shows quality parity with the f32 path)
         "tpu_quantized_hist": not args.no_quant,
         "tree_learner": args.learner,
+        # streamed device ingest (io/ingest.py): -1 auto-enables on a
+        # real TPU; --no-ingest pins the host binner for A/B runs
+        "tpu_ingest": 0 if args.no_ingest else -1,
     })
     from lightgbm_tpu.utils import timing
     t0 = time.time()
@@ -113,9 +119,24 @@ def main():
     mets = create_metrics(["auc"], cfg, ds.metadata, ds.num_data)
     g = GBDT()
     g.init(cfg, ds, obj, mets)      # kernel autotuning happens here
+    binning_init_s = time.time() - t0
     tune_s = timing.seconds("autotune")
-    print(f"# binning+init: {time.time()-t0:.1f}s "
-          f"(kernel autotune: {tune_s:.1f}s)", file=sys.stderr)
+    # ingest sub-phases (timing.phase accumulators, device-synced at
+    # phase exit), reported DISJOINT: find_bins = sampled boundary
+    # search; device_xfer = host->device transfer issue (chunked
+    # device_put on the streamed path — nested inside the bin_matrix
+    # phase, so it is subtracted back out — plus the bulk [F, N]
+    # upload on the host path); bin_matrix = the value->bin mapping
+    # itself (device kernel time on the streamed path)
+    find_bins_s = timing.seconds("binning/find_bins")
+    ingest_xfer_s = timing.seconds("binning/device_xfer")
+    bin_matrix_s = max(
+        timing.seconds("binning/bin_matrix") - ingest_xfer_s, 0.0)
+    device_xfer_s = ingest_xfer_s + timing.seconds("init/upload_bins")
+    print(f"# binning+init: {binning_init_s:.1f}s "
+          f"(find_bins {find_bins_s:.1f}s, bin_matrix {bin_matrix_s:.1f}s, "
+          f"device_xfer {device_xfer_s:.1f}s, "
+          f"kernel autotune: {tune_s:.1f}s)", file=sys.stderr)
 
     import numpy as _np
 
@@ -164,7 +185,12 @@ def main():
     result = {
         "phases": {"tune_s": round(tune_s, 2),
                    "compile_s": round(compile_s, 2),
-                   "train_s": round(train_s, 2)},
+                   "train_s": round(train_s, 2),
+                   "binning_init_s": round(binning_init_s, 2),
+                   "find_bins_s": round(find_bins_s, 2),
+                   "bin_matrix_s": round(bin_matrix_s, 2),
+                   "device_xfer_s": round(device_xfer_s, 2),
+                   "ingest": "host" if args.no_ingest else "auto"},
         "metric": ("HIGGS-class GBDT training throughput "
                    f"({args.rows} rows x 28 feat, {args.leaves} leaves, "
                    f"{args.max_bin} bins, {args.iters} iters, "

@@ -319,6 +319,19 @@ class Config:
     # a bounded queue keeps throughput flat at the short-chain rate.
     # 0 disables (queue unbounded).
     tpu_dispatch_sync_interval: int = 32
+    # streamed TPU-side ingest (io/ingest.py): value->bin mapping runs
+    # on device as a jitted chunked kernel, raw row chunks stream
+    # host->device double-buffered, and the feature-major bin matrix is
+    # assembled directly on device — the full host bin matrix,
+    # transpose and bulk upload disappear. Bit-exact against the host
+    # binner. -1 = auto (on when running on a real TPU); 0 = off
+    # (host binner); 1 = force on any backend (parity tests). Datasets
+    # where EFB actually bundles take the host path regardless, so the
+    # bundling decision and bundled matrix stay bit-identical.
+    tpu_ingest: int = -1
+    # rows per ingest pipeline chunk; 0 = auto (a power of two sized so
+    # one chunk carries ~64 MB of raw values).
+    tpu_ingest_chunk_rows: int = 0
 
     def __post_init__(self):
         self._raw_params: Dict[str, str] = {}
@@ -440,6 +453,10 @@ class Config:
                 log.warning("device_type=%s requested but "
                             "LGBM_TPU_PLATFORM=%s pins the backend",
                             dt, pin)
+        if self.tpu_ingest not in (-1, 0, 1):
+            log.warning("tpu_ingest=%d is not one of -1/0/1; using -1 "
+                        "(auto)", self.tpu_ingest)
+            self.tpu_ingest = -1
         if self.tpu_autotune not in ("on", "off", "exhaustive"):
             log.warning("tpu_autotune=%r is not one of on/off/exhaustive;"
                         " using 'on'", self.tpu_autotune)

@@ -142,6 +142,10 @@ class TpuDataset:
         self.used_feature_map: np.ndarray = np.array([], np.int32)
         self.real_to_inner: dict = {}
         self.bins: Optional[np.ndarray] = None      # [N, F_used]
+        # device-resident feature-major bins (io/ingest.py streamed
+        # ingest): [F_used, N] uint8/int32 jax array; exactly one of
+        # bins / bins_t_dev is set after construction
+        self.bins_t_dev = None
         self.metadata = Metadata()
         self.feature_names: List[str] = []
         self.max_bin_global = 1
@@ -195,12 +199,19 @@ class TpuDataset:
         else:
             with timing.phase("binning/find_bins"):
                 self._construct_mappers(X, set(categorical))
-        with timing.phase("binning/bin_matrix"):
-            self._bin_matrix(X)
-        if mappers is None:
+        with timing.phase("binning/bin_matrix") as ph:
+            self._bin_matrix(X, efb_possible=(mappers is None
+                                              and reference is None))
+            if self.bins_t_dev is not None:
+                # device phase: sync at phase exit so queued kernel
+                # time lands here, not in a later unrelated phase
+                ph.watch(self.bins_t_dev)
+        if mappers is None and self.bins is not None:
             # distributed shards skip EFB: bundling is data-dependent
             # (find_bundles over LOCAL bins) and would diverge across
-            # ranks; parallel learners run unbundled anyway
+            # ranks; parallel learners run unbundled anyway. The device
+            # ingest path pre-probed EFB on the reference's own sample
+            # (_efb_would_bundle) and only runs when nothing bundles.
             with timing.phase("binning/efb"):
                 self._apply_efb()
         return self
@@ -222,8 +233,78 @@ class TpuDataset:
         self.max_bin_global = max(
             (m.num_bin for m in self.mappers), default=1)
 
-    def _bin_matrix(self, X: np.ndarray) -> None:
+    def _bin_matrix(self, X: np.ndarray, efb_possible: bool = False) -> None:
+        """Bin the whole matrix: streamed device ingest (io/ingest.py)
+        when enabled and reproducible, else the host binner."""
+        self.bins_t_dev = None
+        if self._device_ingest_ok(X, efb_possible):
+            from .ingest import DeviceBinner, IngestUnsupported
+            try:
+                binner = DeviceBinner(self.mappers, self.used_feature_map,
+                                      self.config, X.dtype)
+            except IngestUnsupported as e:
+                log.debug("device ingest unavailable (%s); host binner", e)
+            else:
+                self.bins_t_dev = binner.bin_matrix(X)
+                self.bins = None
+                log.info("streamed device ingest: %d rows binned on "
+                         "device in %d-row chunks", self.num_data,
+                         binner.chunk_rows)
+                return
         self.bins = self.bin_rows(X)
+
+    def _device_ingest_ok(self, X: np.ndarray, efb_possible: bool) -> bool:
+        """Gate for the streamed device path: config-enabled, usable
+        features, exact-comparison dtype, no EFB interaction (a valid
+        set of a bundled train set must produce bundled host bins; a
+        fresh set that WOULD bundle takes the host path so the bundling
+        decision and bundled matrix stay bit-identical)."""
+        from .ingest import ingest_enabled, mappers_supported
+        if not ingest_enabled(self.config):
+            return False
+        if not self.mappers:
+            return False
+        if X.dtype not in (np.float32, np.float64):
+            return False
+        if not mappers_supported(self.mappers):
+            return False
+        ref = self._reference
+        if ref is not None and ref.bundles is not None:
+            return False
+        if efb_possible and self._efb_would_bundle(X):
+            log.info("EFB bundles this data; using the host binner so "
+                     "bundling stays bit-identical (set "
+                     "enable_bundle=false to stream ingest instead)")
+            return False
+        return True
+
+    def _efb_would_bundle(self, X: np.ndarray) -> bool:
+        """Replicate find_bundles' own sampled decision (io/efb.py
+        would_bundle) without a full host bin matrix: bin the SAME
+        rng(3) row sample it would draw and ask it directly. Identical
+        verdict to the host path by construction — binning is
+        row-wise."""
+        cfg = self.config
+        if not cfg.enable_bundle or self.num_features <= 1:
+            return False
+        from .efb import sample_rows_for_probe, would_bundle
+        idx = sample_rows_for_probe(X.shape[0])
+        sample = X if idx is None else X[idx]
+        return would_bundle(self.bin_rows(np.asarray(sample)),
+                            self.mappers, cfg.max_conflict_rate)
+
+    def host_bins(self) -> Optional[np.ndarray]:
+        """The [N, F] host bin matrix in the host storage tier
+        (bin_dtype). Device-ingested sets download TRANSIENTLY — the
+        result is returned, not stored, so the one-of-bins/bins_t_dev
+        invariant (and the device-resident fast path) stays intact."""
+        if self.bins is None and self.bins_t_dev is not None:
+            log.info("materializing device-binned matrix on host "
+                     "(%d rows)", self.num_data)
+            return np.ascontiguousarray(
+                np.asarray(self.bins_t_dev).T).astype(
+                self.bin_dtype(), copy=False)
+        return self.bins
 
     def bin_rows(self, X: np.ndarray) -> np.ndarray:
         """Bin a block of rows (post-drop feature layout) with this
@@ -366,6 +447,11 @@ class TpuDataset:
     def create_valid(self, X: np.ndarray, metadata: Metadata) -> "TpuDataset":
         v = TpuDataset(self.config)
         v.construct_from_matrix(np.asarray(X), metadata, reference=self)
+        # CreateValid's contract (dataset.cpp:368): the valid set BINS
+        # with the train set's mappers, never re-derives them — the
+        # streamed ingest path rides the same guarantee
+        assert v.mappers is self.mappers, \
+            "create_valid must never re-derive bin mappers"
         return v
 
     # -- binary cache (SaveBinaryFile parity, dataset.cpp:542) --------------
@@ -376,7 +462,7 @@ class TpuDataset:
     BINARY_TOKEN = b"______LightGBM_TPU_Binary_File_Tokenv2____\n"
     BINARY_TOKEN_V1 = b"______LightGBM_TPU_Binary_File_Token______\n"
 
-    def _pack_nibble_columns(self):
+    def _pack_nibble_columns(self, bins: Optional[np.ndarray] = None):
         """4-bit storage tier (the reference's Dense4bitsBin,
         src/io/dense_nbits_bin.hpp:37-58): columns with <= 16 bins are
         nibble-packed two-rows-per-byte in the binary cache. (No
@@ -384,26 +470,28 @@ class TpuDataset:
         8 per 128-row MXU tile in the wave kernel, so packing would
         only inflate the matmul.) Returns (bins_or_packed, packed_cols).
         """
-        if self.bins is None or self.bins.dtype != np.uint8 \
+        if bins is None:
+            bins = self.bins
+        if bins is None or bins.dtype != np.uint8 \
                 or not self.mappers:
-            return self.bins, []
+            return bins, []
         packed_cols = [i for i, m in enumerate(self.mappers)
                        if m.num_bin <= 16]
         if not packed_cols:
-            return self.bins, []
-        out = {"shape": self.bins.shape}
-        n = self.bins.shape[0]
+            return bins, []
+        out = {"shape": bins.shape}
+        n = bins.shape[0]
         half = (n + 1) // 2
         for i in packed_cols:
-            col = self.bins[:, i]
+            col = bins[:, i]
             lo = col[0::2]
             hi = np.zeros(half, np.uint8)
             hi[:n // 2] = col[1::2]
             out[i] = (lo | (hi << 4)).astype(np.uint8)
         packed_set = set(packed_cols)
-        keep = [i for i in range(self.bins.shape[1])
+        keep = [i for i in range(bins.shape[1])
                 if i not in packed_set]
-        out["rest"] = self.bins[:, keep]
+        out["rest"] = bins[:, keep]
         out["keep"] = keep
         return out, packed_cols
 
@@ -422,7 +510,10 @@ class TpuDataset:
 
     def save_binary(self, filename: str) -> None:
         import pickle
-        bins_repr, packed_cols = self._pack_nibble_columns()
+        # device-ingested sets download transiently (host_bins keeps
+        # the device-resident layout authoritative)
+        bins_repr, packed_cols = self._pack_nibble_columns(
+            self.host_bins())
         with open(filename, "wb") as fh:
             fh.write(self.BINARY_TOKEN)
             pickle.dump({

@@ -32,18 +32,52 @@ import numpy as np
 from ..utils import log
 
 
+EFB_SAMPLE_CNT = 50_000
+
+
+def sample_rows_for_probe(n: int):
+    """Row indices find_bundles would draw from an n-row bin matrix —
+    THE sampling contract shared by the streamed-ingest probes
+    (io/dataset.py, io/loader.py): same rng(3), same count. Returns
+    None when find_bundles would use every row."""
+    if n > EFB_SAMPLE_CNT:
+        return np.random.default_rng(3).choice(n, EFB_SAMPLE_CNT,
+                                               replace=False)
+    return None
+
+
+def would_bundle(sample_bins: np.ndarray, mappers,
+                 max_conflict_rate: float) -> bool:
+    """Bundling decision from a pre-binned probe sample (the rows
+    ``sample_rows_for_probe`` selected): True iff find_bundles on the
+    full matrix would bundle anything. One definition for both
+    streamed-ingest callers so the bit-identical-bundling guarantee
+    cannot de-synchronize."""
+    if sample_bins.shape[1] <= 1:
+        return False
+    db = np.array([m.default_bin for m in mappers], np.int32)
+    nb = np.array([m.num_bin for m in mappers], np.int32)
+    bundles = find_bundles(sample_bins, db, nb, max_conflict_rate,
+                           presampled=True)
+    return len(bundles) < sample_bins.shape[1]
+
+
 def find_bundles(bins: np.ndarray, default_bins: np.ndarray,
                  num_bins: np.ndarray, max_conflict_rate: float,
-                 sample_cnt: int = 50_000,
-                 max_bundle_bins: int = 255) -> List[List[int]]:
+                 sample_cnt: int = EFB_SAMPLE_CNT,
+                 max_bundle_bins: int = 255,
+                 presampled: bool = False) -> List[List[int]]:
     """Greedy conflict-bounded grouping (Dataset::FindGroups,
     dataset.cpp:66-159): features ordered by non-default count; each
     joins the first bundle whose accumulated conflicts stay under
-    ``max_conflict_rate * n`` and whose total bin width fits."""
+    ``max_conflict_rate * n`` and whose total bin width fits.
+    ``presampled``: ``bins`` already IS the rng(3) row sample (the
+    streamed-ingest probe, io/dataset.py _efb_would_bundle) — skip the
+    internal subsample so both callers see identical rows."""
     n, f = bins.shape
     if f <= 1:
         return [[j] for j in range(f)]
-    if n > sample_cnt:
+    if n > sample_cnt and not presampled:
         idx = np.random.default_rng(3).choice(n, sample_cnt,
                                               replace=False)
         sample = bins[idx]

@@ -1,0 +1,454 @@
+"""Streamed TPU-side ingest: device binning with a double-buffered
+host->device chunk pipeline.
+
+The host binner (io/binning.py + the threaded C++ bulk binner) maps
+values to bins one full column scan at a time while the TPU idles; at
+HIGGS scale that is ~29 s of binning against ~112 s of training. This
+module moves the value->bin mapping onto the device, mirroring the
+reference's streamed two-round ingest design
+(DatasetLoader::ConstructFromSampleData, src/io/dataset_loader.cpp:499:
+bin boundaries from a bounded ``bin_construct_sample_cnt`` sample, then
+a streaming pass that bins rows as they arrive):
+
+- bin boundaries still come from the bounded row sample
+  (io/dataset.py find_column_mappers — unchanged semantics);
+- the value->bin map runs on device as a jitted chunked kernel: a
+  branchless lower-bound search over per-feature ``bin_upper_bound``
+  plus the missing/zero-bin/categorical rules of
+  ``BinMapper.value_to_bin``, BIT-EXACT against the host path (see
+  "exactness" below);
+- raw row chunks stream host->device double-buffered: a worker thread
+  prepares chunk k+1 (column select, key planes) while chunk k's
+  async ``device_put`` + kernel dispatch are in flight, so transfer
+  overlaps compute and the full host uint8 matrix + transpose + bulk
+  upload disappear from the critical path;
+- the feature-major ``[F, N]`` ``bins_t`` matrix is assembled directly
+  on device (one concatenate over chunk outputs), which is exactly the
+  layout the wave grower consumes (models/gbdt.py).
+
+Exactness
+---------
+jax runs with x64 disabled, so comparing values against the float64
+``bin_upper_bound`` cannot use device floats directly. Instead every
+comparison is done in the *sortable-integer* order of IEEE-754: a
+float maps to an unsigned key (sign bit flipped for positives, all
+bits flipped for negatives) whose integer order equals the float
+order. Two cases:
+
+- float32 input: keys are computed ON DEVICE from the raw f32 bits;
+  each float64 bound is rounded DOWN to float32 first. For any f32
+  value x and f64 bound b, ``b < x  <=>  floor32(b) < x`` (the largest
+  f32 <= b preserves the strict predicate over f32 operands), so the
+  f32 key search reproduces the f64 ``searchsorted(..., side="left")``
+  exactly.
+- float64 input: the host splits each value's 64-bit key into two
+  uint32 planes (same bytes on the wire as the raw f64) and the device
+  compares lexicographically — exact total order, no rounding anywhere.
+
+``-0.0`` is normalized to ``+0.0`` (``v + 0.0``) on both sides before
+key extraction: numpy's searchsorted treats them as equal while the
+key order would not, and the zero-as-one-bin boundaries sit at
+±kZeroThreshold right next to that crossing.
+
+NaN follows ``value_to_bin``: mapped as 0.0, then overridden to the
+last bin for MissingType.NAN features. Categorical columns are
+truncated to int on host (few columns, cheap) and matched against the
+category table on device.
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+from typing import List, Sequence
+
+import numpy as np
+
+from ..utils import log, timing
+from .binning import BinMapper, BinType, MissingType
+
+_TARGET_CHUNK_BYTES = 64 << 20      # ~64 MB of raw values per chunk
+_MIN_CHUNK_ROWS = 1 << 14
+_MAX_CHUNK_ROWS = 1 << 21
+
+
+class IngestUnsupported(Exception):
+    """Raised at DeviceBinner construction when the mapper set has a
+    shape the device kernel cannot reproduce bit-exactly (callers fall
+    back to the host binner)."""
+
+
+def ingest_enabled(config) -> bool:
+    """Config gate: tpu_ingest=1 forces the device path on any backend
+    (tests), 0 disables, -1 (default) auto-enables on a real TPU."""
+    t = getattr(config, "tpu_ingest", -1)
+    if t == 0:
+        return False
+    if t >= 1:
+        return True
+    from ..utils.device import on_tpu
+    return on_tpu()
+
+
+def mappers_supported(mappers: Sequence[BinMapper]) -> bool:
+    """True when every mapper is reproducible on device: categorical
+    tables must fit int32 (host matching runs at int64)."""
+    for m in mappers:
+        if m.bin_type == BinType.CATEGORICAL:
+            if any(abs(int(c)) >= 2 ** 31 for c in m.bin_2_categorical):
+                return False
+    return True
+
+
+def auto_chunk_rows(config, n_features: int, itemsize: int) -> int:
+    """Rows per pipeline chunk: the config knob, or a power of two
+    sized so one chunk's raw values are ~64 MB on the wire."""
+    knob = int(getattr(config, "tpu_ingest_chunk_rows", 0) or 0)
+    if knob > 0:
+        return knob
+    per_row = max(n_features * itemsize, 1)
+    c = max(_TARGET_CHUNK_BYTES // per_row, 1)
+    c = 1 << int(np.floor(np.log2(c)))
+    return int(min(max(c, _MIN_CHUNK_ROWS), _MAX_CHUNK_ROWS))
+
+
+def prefetch(thunks, depth: int = 2):
+    """Evaluate an iterator of zero-arg callables on ONE worker thread
+    with a bounded lookahead, yielding results in order — the host
+    half of the double buffer: while the device chews on chunk k, the
+    worker slices/keys chunk k+1. One thread is deliberate: host prep
+    is memory-bandwidth bound and the results must stay ordered."""
+    it = iter(thunks)
+    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+        q: collections.deque = collections.deque()
+        try:
+            for _ in range(max(depth, 1)):
+                try:
+                    q.append(ex.submit(next(it)))
+                except StopIteration:
+                    break
+            while q:
+                fut = q.popleft()
+                try:
+                    q.append(ex.submit(next(it)))
+                except StopIteration:
+                    pass
+                yield fut.result()
+        finally:
+            for f in q:
+                f.cancel()
+
+
+# -- sortable-integer float keys --------------------------------------------
+
+def _keys64_host(v: np.ndarray):
+    """float64 [..] -> (hi, lo) uint32 key planes, integer order ==
+    float order (NaN-free input)."""
+    b = np.ascontiguousarray(v, np.float64).view(np.uint64)
+    neg = (b >> np.uint64(63)).astype(bool)
+    mask = np.where(neg, np.uint64(0xFFFFFFFFFFFFFFFF),
+                    np.uint64(0x8000000000000000))
+    u = b ^ mask
+    return ((u >> np.uint64(32)).astype(np.uint32),
+            u.astype(np.uint32))
+
+
+def _key32_host(v: np.ndarray) -> np.ndarray:
+    """float32 [..] -> uint32 key (NaN-free input)."""
+    b = np.ascontiguousarray(v, np.float32).view(np.uint32)
+    neg = (b >> np.uint32(31)).astype(bool)
+    mask = np.where(neg, np.uint32(0xFFFFFFFF), np.uint32(0x80000000))
+    return b ^ mask
+
+
+def _floor32(b64: np.ndarray) -> np.ndarray:
+    """Largest float32 <= each float64 entry (rounds DOWN, so the
+    strict `bound < x` predicate is preserved for float32 x)."""
+    f = b64.astype(np.float32)
+    over = f.astype(np.float64) > b64
+    down = np.nextafter(f, np.float32(-np.inf))
+    return np.where(over, down, f).astype(np.float32)
+
+
+def _cat_iv_host(col: np.ndarray) -> np.ndarray:
+    """Host half of the categorical map: truncate toward zero to int32
+    with NaN/out-of-range -> -1 (never a category; negatives were
+    NaN-ified at find_bin time, bin.cpp:304)."""
+    col = np.asarray(col, np.float64)
+    with np.errstate(invalid="ignore"):
+        bad = np.isnan(col) | (np.abs(col) >= 2.0 ** 31)
+        safe = np.where(bad, -1.0, col)
+    return safe.astype(np.int64).astype(np.int32)
+
+
+# -- the device binner -------------------------------------------------------
+
+class DeviceBinner:
+    """Jitted chunked value->bin kernel for one mapper set.
+
+    Built once per dataset; ``bin_matrix`` (whole in-memory matrix,
+    threaded prefetch) and ``start_stream`` (two-round loader feed)
+    share the same compiled chunk function. ``x_dtype`` selects the
+    exact-comparison scheme (see module docstring)."""
+
+    def __init__(self, mappers: List[BinMapper],
+                 used_feature_map: np.ndarray, config,
+                 x_dtype) -> None:
+        import jax.numpy as jnp
+        if not mappers:
+            raise IngestUnsupported("no usable features")
+        if not mappers_supported(mappers):
+            raise IngestUnsupported("categorical table exceeds int32")
+        x_dtype = np.dtype(x_dtype)
+        if x_dtype not in (np.float32, np.float64):
+            raise IngestUnsupported(f"dtype {x_dtype} not supported")
+        self.mappers = mappers
+        self.f32_input = x_dtype == np.float32
+        used = np.asarray(used_feature_map, np.int64)
+        self.num_inner = [i for i, m in enumerate(mappers)
+                          if m.bin_type == BinType.NUMERICAL]
+        self.cat_inner = [i for i, m in enumerate(mappers)
+                         if m.bin_type != BinType.NUMERICAL]
+        self.num_cols = used[self.num_inner]       # real/source columns
+        self.cat_cols = used[self.cat_inner]
+        max_bin_global = max(m.num_bin for m in mappers)
+        self.out_dtype = np.uint8 if max_bin_global <= 256 else np.int32
+        self.chunk_rows = auto_chunk_rows(config, len(mappers),
+                                          x_dtype.itemsize)
+
+        # numerical tables: per-feature search range r, NaN bin, and the
+        # bound keys padded to a power of two with the max key (never
+        # `< x`, so padding never counts)
+        rs, nan_bins, bounds = [], [], []
+        for i in self.num_inner:
+            m = mappers[i]
+            r = m.num_bin - 1
+            nb = -1
+            if m.missing_type == MissingType.NAN:
+                r -= 1
+                nb = m.num_bin - 1
+            rs.append(r)
+            nan_bins.append(nb)
+            bounds.append(np.asarray(m.bin_upper_bound[:r], np.float64)
+                          + 0.0)                     # -0.0 -> +0.0
+        max_r = max(rs, default=0)
+        Bp = 1 << max(int(np.ceil(np.log2(max_r + 1))), 0)
+        self._Bp = Bp
+        Fn = len(self.num_inner)
+        if self.f32_input:
+            bk = np.full((Fn, Bp), np.uint32(0xFFFFFFFF), np.uint32)
+            for k, bu in enumerate(bounds):
+                bk[k, :len(bu)] = _key32_host(_floor32(bu))
+            self._bhi = jnp.asarray(bk)
+            self._blo = None
+        else:
+            bh = np.full((Fn, Bp), np.uint32(0xFFFFFFFF), np.uint32)
+            bl = np.full((Fn, Bp), np.uint32(0xFFFFFFFF), np.uint32)
+            for k, bu in enumerate(bounds):
+                h, lo = _keys64_host(bu)
+                bh[k, :len(bu)] = h
+                bl[k, :len(bu)] = lo
+            self._bhi = jnp.asarray(bh)
+            self._blo = jnp.asarray(bl)
+        self._nan_bin = jnp.asarray(np.asarray(nan_bins, np.int32))
+
+        # categorical tables (kept per-feature: lengths differ)
+        self._cats = [jnp.asarray(np.asarray(m.bin_2_categorical,
+                                             np.int64).astype(np.int32))
+                      for m in (mappers[i] for i in self.cat_inner)]
+        self._cat_nbin = [mappers[i].num_bin for i in self.cat_inner]
+
+        # static output permutation: chunk kernel emits [numerical;
+        # categorical] row blocks, take() restores mapper order
+        order = np.asarray(self.num_inner + self.cat_inner, np.int64)
+        self._inv_perm = jnp.asarray(np.argsort(order).astype(np.int32))
+        self._chunk_fn = self._build_chunk_fn()
+
+    # -- kernel --------------------------------------------------------------
+
+    def _build_chunk_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        Bp = self._Bp
+        bhi, blo = self._bhi, self._blo
+        nan_bin = self._nan_bin
+        cats, cat_nbin = self._cats, self._cat_nbin
+        inv_perm = self._inv_perm
+        out_dtype = self.out_dtype
+        f32_input = self.f32_input
+        Fn = len(self.num_inner)
+
+        def gather(b, idx):                  # b [F,Bp], idx [C,F] -> [C,F]
+            return jax.vmap(lambda col, i: col[i],
+                            in_axes=(0, 1), out_axes=1)(b, idx)
+
+        def lower_bound(xh, xl):
+            """Branchless count of bounds < x per (row, feature):
+            uniform binary search, Bp a power of two, pad = max key."""
+            pos = jnp.zeros(xh.shape, jnp.int32)
+            step = Bp
+            while step > 1:
+                step //= 2
+                idx = pos + (step - 1)
+                gh = gather(bhi, idx)
+                go = gh < xh
+                if xl is not None:
+                    gl = gather(blo, idx)
+                    go = go | ((gh == xh) & (gl < xl))
+                pos = jnp.where(go, pos + step, pos)
+            return pos
+
+        def key32_dev(x):
+            b = jax.lax.bitcast_convert_type(x, jnp.uint32)
+            neg = (b >> jnp.uint32(31)).astype(bool)
+            mask = jnp.where(neg, jnp.uint32(0xFFFFFFFF),
+                             jnp.uint32(0x80000000))
+            return b ^ mask
+
+        def chunk(xa, xb, nan, cat_iv):
+            """One chunk -> [F, C] bins. f32 input: xa = raw f32
+            [C, Fn], xb unused. f64 input: xa/xb = hi/lo key planes
+            (uint32), nan = host NaN mask."""
+            parts = []
+            if Fn:
+                if f32_input:
+                    nanm = jnp.isnan(xa)
+                    v = jnp.where(nanm, jnp.float32(0.0), xa) \
+                        + jnp.float32(0.0)           # -0.0 -> +0.0
+                    pos = lower_bound(key32_dev(v), None)
+                else:
+                    nanm = nan
+                    pos = lower_bound(xa, xb)
+                out_num = jnp.where(nanm & (nan_bin[None, :] >= 0),
+                                    nan_bin[None, :], pos)
+                parts.append(out_num.T)
+            for k, cvals in enumerate(cats):
+                iv = cat_iv[:, k]
+                default = jnp.int32(cat_nbin[k] - 1)
+                if cvals.shape[0]:
+                    eq = iv[:, None] == cvals[None, :]
+                    hit = jnp.argmax(eq, axis=1).astype(jnp.int32)
+                    out_c = jnp.where(eq.any(axis=1), hit, default)
+                else:
+                    out_c = jnp.full(iv.shape, default, jnp.int32)
+                parts.append(out_c[None, :])
+            allout = (parts[0] if len(parts) == 1
+                      else jnp.concatenate(parts, axis=0))
+            return jnp.take(allout, inv_perm, axis=0).astype(out_dtype)
+
+        return jax.jit(chunk)
+
+    # -- host-side chunk prep ------------------------------------------------
+
+    def _prep_chunk(self, X: np.ndarray):
+        """Slice + key one chunk on the host (worker-thread half of the
+        double buffer). Returns the transfer tuple, tail-padded to the
+        fixed chunk shape so every chunk reuses one compiled kernel."""
+        C = self.chunk_rows
+        k = X.shape[0]
+        pad = C - k
+        Xn = X[:, self.num_cols] if len(self.num_cols) else \
+            np.zeros((k, 0), X.dtype)
+        if self.f32_input:
+            xa = np.ascontiguousarray(Xn, np.float32)
+            if pad:
+                xa = np.pad(xa, ((0, pad), (0, 0)))
+            xb = nan = np.zeros((0,), np.uint32)   # unused placeholders
+        else:
+            v = np.ascontiguousarray(Xn, np.float64)
+            nanm = np.isnan(v)
+            v = np.where(nanm, 0.0, v) + 0.0        # NaN->0, -0.0->+0.0
+            xa, xb = _keys64_host(v)
+            nan = nanm
+            if pad:
+                xa = np.pad(xa, ((0, pad), (0, 0)))
+                xb = np.pad(xb, ((0, pad), (0, 0)))
+                nan = np.pad(nan, ((0, pad), (0, 0)))
+        if len(self.cat_cols):
+            cat_iv = _cat_iv_host(X[:, self.cat_cols])
+            if pad:
+                cat_iv = np.pad(cat_iv, ((0, pad), (0, 0)),
+                                constant_values=-1)
+        else:
+            cat_iv = np.zeros((C, 0), np.int32)
+        return (xa, xb, nan, cat_iv), k
+
+    def _submit(self, prepped):
+        """Main-thread half: async transfer + kernel dispatch. Returns
+        the [F, k] device block (tail chunks sliced to their true
+        rows)."""
+        import jax
+        (xa, xb, nan, cat_iv), k = prepped
+        with timing.phase("binning/device_xfer"):
+            xa, xb, nan, cat_iv = jax.device_put((xa, xb, nan, cat_iv))
+        out = self._chunk_fn(xa, xb, nan, cat_iv)
+        if k < self.chunk_rows:
+            out = out[:, :k]
+        return out
+
+    # -- drivers -------------------------------------------------------------
+
+    def bin_matrix(self, X: np.ndarray):
+        """Whole in-memory matrix -> [F, N] device bins with the
+        double-buffered pipeline (worker preps chunk k+1 while chunk
+        k's transfer + kernel are in flight)."""
+        import jax.numpy as jnp
+        n = X.shape[0]
+        C = self.chunk_rows
+        starts = list(range(0, n, C))
+
+        def thunk(r0):
+            return lambda: self._prep_chunk(X[r0:min(r0 + C, n)])
+
+        outs = [self._submit(p)
+                for p in prefetch(thunk(r0) for r0 in starts)]
+        bins_t = outs[0] if len(outs) == 1 else jnp.concatenate(outs, 1)
+        log.debug("device ingest: %d rows x %d features in %d chunk(s) "
+                  "of %d rows", n, len(self.mappers), len(outs), C)
+        return bins_t
+
+    def start_stream(self) -> "IngestStream":
+        return IngestStream(self)
+
+
+class IngestStream:
+    """Feed-driven variant for streaming loaders (two-round text
+    loading): rows arrive in parser-sized blocks, are repacked to the
+    binner's chunk granularity and dispatched asynchronously — the
+    caller's parsing of the next block IS the host half of the double
+    buffer."""
+
+    def __init__(self, binner: DeviceBinner):
+        self._b = binner
+        self._pend: List[np.ndarray] = []
+        self._pend_rows = 0
+        self._outs: List = []
+        self._rows = 0
+
+    def feed(self, X: np.ndarray) -> None:
+        C = self._b.chunk_rows
+        self._pend.append(np.asarray(X))
+        self._pend_rows += X.shape[0]
+        self._rows += X.shape[0]
+        while self._pend_rows >= C:
+            block = (self._pend[0] if len(self._pend) == 1
+                     else np.concatenate(self._pend, axis=0))
+            self._outs.append(self._b._submit(
+                self._b._prep_chunk(block[:C])))
+            rest = block[C:]
+            self._pend = [rest] if rest.shape[0] else []
+            self._pend_rows = rest.shape[0]
+
+    def finish(self):
+        """-> [F, N] device bins over every fed row."""
+        import jax.numpy as jnp
+        if self._pend_rows:
+            block = (self._pend[0] if len(self._pend) == 1
+                     else np.concatenate(self._pend, axis=0))
+            self._outs.append(self._b._submit(self._b._prep_chunk(block)))
+            self._pend, self._pend_rows = [], 0
+        if not self._outs:
+            return jnp.zeros((len(self._b.mappers), 0),
+                             self._b.out_dtype)
+        return (self._outs[0] if len(self._outs) == 1
+                else jnp.concatenate(self._outs, axis=1))

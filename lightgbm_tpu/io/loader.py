@@ -238,10 +238,38 @@ class DatasetLoader:
                 Xs[:, keep_cols], cfg, categorical,
                 total_rows=n, presampled=True))
 
-        # pass 2: stream + bin
+        # pass 2: stream + bin. With device ingest enabled the chunks
+        # feed the jitted device binner (io/ingest.py) and the [F, N]
+        # matrix assembles directly on device — parsing the next text
+        # block is the host half of the double buffer, so transfer and
+        # binning overlap the tokenizer. Host path otherwise.
         f_used = max(len(ds.mappers), 1)
         dtype = np.uint8 if ds.max_bin_global <= 256 else np.int32
-        bins = np.zeros((n, f_used), dtype)
+        from .ingest import (DeviceBinner, IngestUnsupported,
+                             ingest_enabled)
+        stream = None
+        if (ingest_enabled(cfg) and ds.mappers
+                and (reference is None or reference.bundles is None)):
+            try:
+                stream = DeviceBinner(ds.mappers, ds.used_feature_map,
+                                      cfg, np.float64).start_stream()
+            except IngestUnsupported as e:
+                log.debug("two_round device ingest unavailable (%s); "
+                          "host binner", e)
+        bins = (None if stream is not None
+                else np.zeros((n, f_used), dtype))
+        # EFB probe sample: the same rng(3) rows find_bundles would
+        # draw, collected RAW while streaming and host-binned at the
+        # end, so the bundling decision is bit-identical to the host
+        # path's (io/dataset.py _efb_would_bundle has the in-memory
+        # analog)
+        efb_sorted = None
+        efb_rows: List[np.ndarray] = []
+        if (stream is not None and reference is None
+                and cfg.enable_bundle and ds.num_features > 1):
+            from .efb import sample_rows_for_probe
+            idx = sample_rows_for_probe(n)
+            efb_sorted = np.arange(n) if idx is None else np.sort(idx)
         label = np.zeros(n, np.float32)
         weight = np.zeros(n, np.float32) if weight_idx >= 0 else None
         group_col = np.zeros(n, np.float64) if group_idx >= 0 else None
@@ -284,7 +312,15 @@ class DatasetLoader:
             if group_col is not None:
                 group_col[row:row + k] = Xc[:, group_idx]
             Xf = Xc[:, keep_cols]
-            bins[row:row + k] = ds.bin_rows(Xf)
+            if stream is not None:
+                if efb_sorted is not None:
+                    lo = np.searchsorted(efb_sorted, row)
+                    hi = np.searchsorted(efb_sorted, row + k)
+                    if hi > lo:
+                        efb_rows.append(Xf[efb_sorted[lo:hi] - row])
+                stream.feed(Xf)
+            else:
+                bins[row:row + k] = ds.bin_rows(Xf)
             row += k
 
         for ln in self._data_lines(filename):
@@ -293,12 +329,33 @@ class DatasetLoader:
                 flush(buf)
                 buf = []
         flush(buf)
-        ds.bins = bins
+        if stream is None:
+            ds.bins = bins
+        else:
+            dev = stream.finish()
+            bundled = False
+            if efb_sorted is not None and efb_rows:
+                from .efb import would_bundle
+                bundled = would_bundle(
+                    ds.bin_rows(np.concatenate(efb_rows)),
+                    ds.mappers, cfg.max_conflict_rate)
+            if bundled:
+                # EFB engages on this data: materialize the host
+                # layout so _apply_efb bundles the same full matrix
+                # the host path would have built
+                log.info("two_round: EFB bundles this data; "
+                         "materializing device bins on host")
+                ds.bins = np.ascontiguousarray(np.asarray(dev).T)
+            else:
+                ds.bins_t_dev = dev
+                log.info("two_round: streamed device ingest "
+                         "(%d rows)", n)
         ds.metadata = self._assemble_metadata(
             filename, label if sparsed.label is not None else None,
             weight, group_col)
         ds.metadata.check_or_partition(n)
-        ds._apply_efb()     # handles both fresh and reference bundles
+        if ds.bins is not None:
+            ds._apply_efb()  # handles both fresh and reference bundles
         log.info("two_round load: %d rows binned in %d-row blocks",
                  n, chunk_rows)
         return ds

@@ -114,33 +114,54 @@ class GBDT:
         # bundles share columns (io/efb.py)
         host_bins = (train_data.bundled_bins if self._use_bundles
                      else train_data.bins)
-        bins_t = np.ascontiguousarray(host_bins.T)
-        if bins_t.dtype == np.uint16:
-            # device kernels take uint8 or int32; the uint16 tier only
-            # sizes host storage (io/dataset.py bin_dtype)
-            bins_t = bins_t.astype(np.int32)
-        if self._pad_rows:
-            bins_t = np.pad(bins_t, ((0, 0), (0, self._pad_rows)))
-        if self._pad_features:
-            bins_t = np.pad(bins_t, ((0, self._pad_features), (0, 0)))
-        self._num_bin_rows = bins_t.shape[0]
-        if self._grower_cfg.packed4:
-            # 4-bit tier: two features per HBM byte (low nibble = even
-            # feature). The grower's kernels unpack in VMEM; every
-            # OTHER consumer of the training bins (replay_partition in
-            # early-stop trimming, continued training, refit) must go
-            # through _train_bins_unpacked().
-            bins_t = self._pack4_host(bins_t)
-            log.info("4-bit packed bins: %.1f MB HBM "
-                     "(vs %.1f MB unpacked)",
-                     bins_t.nbytes / 1e6, 2 * bins_t.nbytes / 1e6)
-        with timing.phase("init/upload_bins"):
+        dev_bins = (train_data.bins_t_dev
+                    if host_bins is None and not self._use_bundles
+                    else None)
+        if dev_bins is not None:
+            # streamed ingest (io/ingest.py): the bins are already
+            # device-resident in the grower's [F, N] layout — pad and
+            # nibble-pack on device; no host matrix ever existed
+            bins_t = dev_bins
+            if self._pad_rows:
+                bins_t = jnp.pad(bins_t, ((0, 0), (0, self._pad_rows)))
+            if self._pad_features:
+                bins_t = jnp.pad(bins_t,
+                                 ((0, self._pad_features), (0, 0)))
+            self._num_bin_rows = bins_t.shape[0]
+            if self._grower_cfg.packed4:
+                bins_t = self._pack4_dev(bins_t)
+        else:
+            bins_t = np.ascontiguousarray(host_bins.T)
+            if bins_t.dtype == np.uint16:
+                # device kernels take uint8 or int32; the uint16 tier
+                # only sizes host storage (io/dataset.py bin_dtype)
+                bins_t = bins_t.astype(np.int32)
+            if self._pad_rows:
+                bins_t = np.pad(bins_t, ((0, 0), (0, self._pad_rows)))
+            if self._pad_features:
+                bins_t = np.pad(bins_t,
+                                ((0, self._pad_features), (0, 0)))
+            self._num_bin_rows = bins_t.shape[0]
+            if self._grower_cfg.packed4:
+                # 4-bit tier: two features per HBM byte (low nibble =
+                # even feature). The grower's kernels unpack in VMEM;
+                # every OTHER consumer of the training bins
+                # (replay_partition in early-stop trimming, continued
+                # training, refit) must go through
+                # _train_bins_unpacked().
+                bins_t = self._pack4_host(bins_t)
+                log.info("4-bit packed bins: %.1f MB HBM "
+                         "(vs %.1f MB unpacked)",
+                         bins_t.nbytes / 1e6, 2 * bins_t.nbytes / 1e6)
+        with timing.phase("init/upload_bins") as ph:
             # grower-facing matrix: train rows (+ alignment) with every
             # valid set's rows appended as weight-0 passengers (see
             # _rebuild_grower_bins); no valids yet at init. The train
             # part is always the first _train_width columns — kept as
-            # a slice view, not a second resident copy.
-            self._bins_dev = jnp.asarray(bins_t)
+            # a slice view, not a second resident copy. The watch
+            # blocks at phase exit so upload/ingest device time is
+            # attributed here, not to the first training iteration.
+            self._bins_dev = ph.watch(jnp.asarray(bins_t))
         self._train_width = bins_t.shape[1]
         self._valid_row_slices: List[tuple] = []
         self._n_total = self._n + self._pad_rows
@@ -292,8 +313,10 @@ class GBDT:
                    else max(td.max_bin_global, 2)),
                 W=W, precision=precision, count_proxy=proxy,
                 packed4=packed4, any_cat=bool(hp.has_cat),
-                bins_bytes=(1 if host_bins is None
-                            or host_bins.dtype == np.uint8 else 4),
+                bins_bytes=(1 if (host_bins.dtype == np.uint8
+                                  if host_bins is not None
+                                  else td.max_bin_global <= 256)
+                            else 4),
                 # per-device rows: only data/voting shard rows across
                 # the mesh (rounded UP — padding below aligns shards
                 # to a chunk multiple, and the int8 overflow filter
@@ -466,7 +489,13 @@ class GBDT:
                   if (self._use_bundles
                       and valid_data.bundles is not None)
                   else valid_data.bins)
-        vb = jnp.asarray(np.ascontiguousarray(v_host.T))
+        if v_host is None and valid_data.bins_t_dev is not None:
+            # streamed ingest: the valid bins are already [F, N] on
+            # device (a device-ingested valid set implies an unbundled
+            # train set — io/dataset.py _device_ingest_ok)
+            vb = valid_data.bins_t_dev
+        else:
+            vb = jnp.asarray(np.ascontiguousarray(v_host.T))
         self._valid_bins_dev.append(vb)
         for t_idx, rec in enumerate(self.records):
             cls = t_idx % self.num_tree_per_iteration
@@ -539,6 +568,15 @@ class GBDT:
             bins_t = np.pad(bins_t, ((0, 1), (0, 0)))
         return (bins_t[0::2] | (bins_t[1::2] << 4)).astype(np.uint8)
 
+    @staticmethod
+    def _pack4_dev(bins_t: jax.Array) -> jax.Array:
+        """_pack4_host for device-resident ingest bins (same layout as
+        the valid-set packing in _rebuild_grower_bins)."""
+        if bins_t.shape[0] % 2:
+            bins_t = jnp.pad(bins_t, ((0, 1), (0, 0)))
+        return jnp.bitwise_or(bins_t[0::2],
+                              jnp.left_shift(bins_t[1::2], jnp.uint8(4)))
+
     @property
     def _bins_train_dev(self) -> jax.Array:
         """The training columns of the grower bin matrix (valid-set
@@ -581,10 +619,7 @@ class GBDT:
             if self._pad_features:
                 vb = jnp.pad(vb, ((0, self._pad_features), (0, 0)))
             if self._grower_cfg.packed4:
-                if vb.shape[0] % 2:
-                    vb = jnp.pad(vb, ((0, 1), (0, 0)))
-                vb = jnp.bitwise_or(
-                    vb[0::2], jnp.left_shift(vb[1::2], jnp.uint8(4)))
+                vb = self._pack4_dev(vb)
             self._valid_row_slices.append((off, nv))
             parts.append(vb.astype(base.dtype))
             off += nv

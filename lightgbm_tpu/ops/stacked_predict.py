@@ -437,8 +437,12 @@ class StackedModel:
             dev = self._device_arrays_pallas(first, ntree, tc)
             offs = tuple(int(o) for o in self._offsets)
             fchunk = 1 << 18
-            handles = []
-            for c0 in range(0, N, fchunk):
+
+            def prep(c0):
+                """Host half of the ingest double buffer
+                (io/ingest.py prefetch): slice/pad/transpose the next
+                row chunk on the worker thread while the device chews
+                on the previous one."""
                 part = rows[c0:c0 + fchunk]
                 nrows = part.shape[0]
                 if nrows < fchunk and N > fchunk:
@@ -448,18 +452,27 @@ class StackedModel:
                     part = np.concatenate([part, np.zeros(
                         (fchunk - nrows,) + part.shape[1:],
                         part.dtype)])
+                if not dev_bin:
+                    part = np.ascontiguousarray(part.T)
+                return part, nrows
+
+            from ..io.ingest import prefetch
+            if dev_bin:     # upload the edge tables once, not per chunk
+                E_d = jnp.asarray(self._E_f32)
+                off_d = jnp.asarray(self._off32)
+                nan_d = jnp.asarray(self._nan_slot)
+            handles = []
+            for part, nrows in prefetch(
+                    (lambda c0=c0: prep(c0))
+                    for c0 in range(0, N, fchunk)):
                 if dev_bin:
                     h = forest_predict_from_x(
-                        jnp.asarray(part), jnp.asarray(self._E_f32),
-                        jnp.asarray(self._off32),
-                        jnp.asarray(self._nan_slot), *dev,
+                        jnp.asarray(part), E_d, off_d, nan_d, *dev,
                         offsets=offs, row_tile=row_tile,
                         interpret=interp)
                 else:
-                    codes_t = jnp.asarray(
-                        np.ascontiguousarray(part.T))
                     h = forest_predict_pallas(
-                        codes_t, *dev, offsets=offs,
+                        part, *dev, offsets=offs,
                         row_tile=row_tile, interpret=interp)
                 handles.append((h, nrows))
             acc = np.concatenate(

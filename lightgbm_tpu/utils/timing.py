@@ -21,13 +21,39 @@ _acc: "OrderedDict[str, float]" = OrderedDict()
 _counts: "OrderedDict[str, int]" = OrderedDict()
 
 
+class _PhaseHandle:
+    """Yielded by ``phase``; lets device phases register the output
+    whose completion the phase should wait for at exit."""
+    __slots__ = ("out",)
+
+    def __init__(self):
+        self.out = None
+
+    def watch(self, out):
+        """Register a (pytree of) device array(s): the phase blocks on
+        it at exit, so queued device time is attributed HERE instead of
+        leaking into whichever later phase first synchronizes."""
+        self.out = out
+        return out
+
+
 @contextmanager
 def phase(name: str):
-    """Accumulate the wall time spent inside the block."""
+    """Accumulate the wall time spent inside the block.
+
+    jax dispatch is async: a phase that merely ISSUES device work
+    records only the issue time, and the device time lands in whichever
+    later phase first synchronizes — silently misattributed. Device
+    phases therefore ``.watch(out)`` their output on the yielded
+    handle, which forces completion at phase exit, before the clock
+    stops."""
     t0 = time.monotonic()
+    h = _PhaseHandle()
     try:
-        yield
+        yield h
     finally:
+        if h.out is not None:
+            _sync(h.out)
         _acc[name] = _acc.get(name, 0.0) + (time.monotonic() - t0)
         _counts[name] = _counts.get(name, 0) + 1
 
