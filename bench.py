@@ -72,6 +72,11 @@ def main():
                          "ICI — the multi-chip path for the v5e-8 "
                          "north-star target (falls back to serial on "
                          "one device)")
+    ap.add_argument("--run-report", default="",
+                    help="write the run-report artifact here "
+                         "(tpu_run_report; .jsonl for line-delimited). "
+                         "The JSON line's phase breakdown comes from "
+                         "this report's phase table either way.")
     args = ap.parse_args()
     if args.quick:
         args.rows, args.iters, args.leaves = 65_536, 20, 63
@@ -88,12 +93,27 @@ def main():
     from lightgbm_tpu.objectives import create_objective
     from lightgbm_tpu.metrics import create_metrics
 
+    # run recorder (obs/recorder.py): per-iteration wall times, HBM and
+    # transfer-byte samples; the phase table it snapshots at finish()
+    # IS the JSON line's phase breakdown (no hand-rolled sub-phase
+    # bookkeeping here)
+    from lightgbm_tpu.obs.recorder import RunRecorder
+    from lightgbm_tpu.utils import timing
+    recorder = RunRecorder(
+        path=args.run_report,
+        meta={"driver": "bench", "rows": args.rows, "iters": args.iters,
+              "leaves": args.leaves, "max_bin": args.max_bin,
+              "learner": args.learner,
+              "quantized": not args.no_quant,
+              "ingest": "host" if args.no_ingest else "auto"}).start()
+
     t0 = time.time()
     # +holdout: the reference's headline quality number is TEST-set AUC
     # (docs/Experiments.rst:125-127); the timed training uses args.rows
     X, y = make_higgs_like(args.rows + HOLDOUT_ROWS)
     X_test, y_test = X[args.rows:], y[args.rows:]
     X, y = X[:args.rows], y[:args.rows]
+    timing.add("bench/datagen", time.time() - t0)
     print(f"# data gen: {time.time()-t0:.1f}s", file=sys.stderr)
 
     cfg = Config().set({
@@ -110,8 +130,8 @@ def main():
         # streamed device ingest (io/ingest.py): -1 auto-enables on a
         # real TPU; --no-ingest pins the host binner for A/B runs
         "tpu_ingest": 0 if args.no_ingest else -1,
+        "tpu_run_report": args.run_report,
     })
-    from lightgbm_tpu.utils import timing
     t0 = time.time()
     ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
     obj = create_objective("binary", cfg)
@@ -150,21 +170,28 @@ def main():
     # one warm-up iteration compiles the grower (a warm persistent
     # compile cache + tuning cache make this step mostly iter0)
     t0 = time.time()
-    g.train_one_iter()
-    sync()
+    with recorder.iteration(1):
+        g.train_one_iter()
+        sync()
     compile_s = time.time() - t0
+    timing.add("bench/compile_iter0", compile_s)
     print(f"# compile+iter0: {compile_s:.1f}s", file=sys.stderr)
 
     t0 = time.time()
-    for _ in range(args.iters - 1):
-        g.train_one_iter()
+    for i in range(args.iters - 1):
+        # per-iteration spans are dispatch-issue time (jax async); the
+        # sync below attributes queued device time to the run total
+        with recorder.iteration(i + 2):
+            g.train_one_iter()
     sync()
     train_s = time.time() - t0
+    timing.add("bench/train", train_s)
     (_, auc, _), = g.get_eval_at(0)
     t0 = time.time()
     test_raw = g.predict_raw(X_test)
     test_auc = _auc(y_test, np.asarray(test_raw))
     pred_s = time.time() - t0
+    timing.add("bench/predict_holdout", pred_s)
     print(f"# {args.iters} iters in {train_s:.1f}s  train-AUC={auc:.5f}  "
           f"test-AUC={test_auc:.5f}  "
           f"(holdout predict {HOLDOUT_ROWS} rows x "
@@ -182,15 +209,21 @@ def main():
           file=sys.stderr)
 
     row_iters_per_s = args.rows * (args.iters - 1) / max(train_s, 1e-9)
+    # the run report's phase table IS the emitted breakdown: every
+    # timing.phase the run touched (binning/find_bins, binning/
+    # bin_matrix, binning/device_xfer, init/upload_bins, autotune/*,
+    # train/step_dispatch, ...) plus the bench/* spans added above —
+    # no hand-maintained sub-phase arithmetic to drift
+    report = recorder.finish(extra={
+        "train_s": round(train_s, 2), "compile_s": round(compile_s, 2),
+        "train_auc": round(float(auc), 5),
+        "test_auc": round(float(test_auc), 5)})
     result = {
-        "phases": {"tune_s": round(tune_s, 2),
-                   "compile_s": round(compile_s, 2),
-                   "train_s": round(train_s, 2),
-                   "binning_init_s": round(binning_init_s, 2),
-                   "find_bins_s": round(find_bins_s, 2),
-                   "bin_matrix_s": round(bin_matrix_s, 2),
-                   "device_xfer_s": round(device_xfer_s, 2),
-                   "ingest": "host" if args.no_ingest else "auto"},
+        "phases": {name: round(rec["total_s"], 2)
+                   for name, rec in report["phases"].items()},
+        "counters": {k: v for k, v in report["counters"].items()
+                     if k.startswith(("ingest/", "transfer/"))},
+        "ingest": "host" if args.no_ingest else "auto",
         "metric": ("HIGGS-class GBDT training throughput "
                    f"({args.rows} rows x 28 feat, {args.leaves} leaves, "
                    f"{args.max_bin} bins, {args.iters} iters, "

@@ -78,6 +78,24 @@ def record_evaluation(eval_result):
     return _callback
 
 
+def record_run(recorder):
+    """Feed per-iteration spans + eval results into a RunRecorder
+    (obs/recorder.py) — the engine.train telemetry seam, installed
+    automatically when ``tpu_run_report`` is set.
+
+    Defined in this module so the pipelined-eval fast path (engine.py
+    builtin_only) stays eligible. Under pipelining, after-iteration
+    callbacks for iteration i run one boosting update late, so the
+    recorded span for i includes iteration i+1's dispatch — wall
+    times are pipeline-accurate, not update-exact (the CLI driver,
+    models/gbdt.py train, records update-exact spans)."""
+    def _callback(env):
+        recorder.tick(env.iteration + 1,
+                      [x[:4] for x in (env.evaluation_result_list or [])])
+    _callback.order = 25
+    return _callback
+
+
 def reset_parameter(**kwargs):
     """Reset parameters after the first iteration (callback.py:113-155).
 

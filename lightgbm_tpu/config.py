@@ -306,8 +306,25 @@ class Config:
     # of trusting stale entries (the dataset binary-token discipline).
     tpu_tuning_cache: str = ""
     # write an xprof/tensorboard device trace of the training loop here
-    # (engine.train wraps the loop in jax.profiler.start/stop_trace)
+    # (obs/profiler.py ProfileWindow brackets the loop with
+    # jax.profiler.start/stop_trace; phase names appear as
+    # TraceAnnotation spans inside the capture)
     tpu_profile_dir: str = ""
+    # iterations to trace when tpu_profile_dir is set: 0 = the whole
+    # boosting loop; N > 0 traces exactly N iterations starting at
+    # iteration 2 (skipping the compile-dominated first iteration), so
+    # the capture shows steady-state device work
+    tpu_profile_iters: int = 0
+    # run-report artifact path (obs/recorder.py): every training run
+    # writes a versioned JSON (or, with a .jsonl suffix, JSONL) report
+    # with per-iteration wall times / leaves / HBM / transfer-byte
+    # deltas, the phase table, and the transfer counters — perf work
+    # diffs these artifacts instead of log tails. Empty = no report.
+    tpu_run_report: str = ""
+    # slow-iteration watchdog (obs/recorder.py): warn with the current
+    # phase table when an iteration exceeds this factor x the trailing
+    # median iteration time (last 64, armed after 8). 0 disables.
+    tpu_watchdog_factor: float = 8.0
     # iterations between host checks for the "no more splits" stop
     # (gbdt.cpp:393-409); device→host reads are high-latency, so the stop
     # is detected periodically instead of every iteration
@@ -457,6 +474,14 @@ class Config:
             log.warning("tpu_ingest=%d is not one of -1/0/1; using -1 "
                         "(auto)", self.tpu_ingest)
             self.tpu_ingest = -1
+        if self.tpu_watchdog_factor < 0:
+            log.warning("tpu_watchdog_factor=%g is negative; disabling "
+                        "the watchdog (0)", self.tpu_watchdog_factor)
+            self.tpu_watchdog_factor = 0.0
+        if self.tpu_profile_iters < 0:
+            log.warning("tpu_profile_iters=%d is negative; tracing the "
+                        "whole loop (0)", self.tpu_profile_iters)
+            self.tpu_profile_iters = 0
         if self.tpu_autotune not in ("on", "off", "exhaustive"):
             log.warning("tpu_autotune=%r is not one of on/off/exhaustive;"
                         " using 'on'", self.tpu_autotune)

@@ -104,6 +104,23 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     if evals_result is not None:
         callbacks.add(callback.record_evaluation(evals_result))
 
+    # run report (obs/recorder.py): when tpu_run_report is set, a
+    # RunRecorder spans the iterations via an internal after-iteration
+    # callback (defined in the callback module, so the pipelined-eval
+    # fast path stays eligible) and serializes the run at the end
+    recorder = None
+    run_report = str(params.get("tpu_run_report", "") or "")
+    if run_report:
+        from .obs.recorder import RunRecorder
+        recorder = RunRecorder(
+            path=run_report,
+            watchdog_factor=float(
+                params.get("tpu_watchdog_factor", 8.0) or 0.0),
+            meta={"driver": "engine.train",
+                  "num_boost_round": num_boost_round,
+                  "init_iteration": init_iteration})
+        callbacks.add(callback.record_run(recorder))
+
     callbacks_before_iter = sorted(
         (cb for cb in callbacks if getattr(cb, "before_iteration", False)),
         key=attrgetter("order"))
@@ -119,22 +136,27 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         booster.add_valid(valid_set, name)
     booster.best_iteration = 0
 
-    # xprof capture of the whole training loop (tpu_profile_dir; the
-    # reference's per-phase wall timers are utils/timing.py — this is
-    # the device-level analog, readable with tensorboard/xprof)
-    profile_dir = params.get("tpu_profile_dir", "")
-    if profile_dir:
-        import jax
-        jax.profiler.start_trace(profile_dir)
+    # xprof capture of the training loop (tpu_profile_dir +
+    # tpu_profile_iters; obs/profiler.py — the device-level analog of
+    # the utils/timing.py wall timers, readable with tensorboard/xprof)
+    from .obs.profiler import ProfileWindow
+    profile = ProfileWindow(
+        str(params.get("tpu_profile_dir", "") or ""),
+        int(params.get("tpu_profile_iters", 0) or 0))
+    if recorder is not None:
+        # started here (not at construction) so an exception during
+        # booster/valid-set setup can't leak the log run-prefix
+        recorder.start()
     try:
         evaluation_result_list = _train_loop(
             booster, params, init_iteration, num_boost_round,
             callbacks_before_iter, callbacks_after_iter, fobj, feval,
-            valid_sets, is_valid_contain_train)
+            valid_sets, is_valid_contain_train, profile)
     finally:
-        if profile_dir:
-            import jax
-            jax.profiler.stop_trace()
+        profile.close()
+        if recorder is not None:
+            recorder.finish(
+                extra={"best_iteration": booster.best_iteration})
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for dataset_name, eval_name, score, _ in evaluation_result_list:
         booster.best_score[dataset_name][eval_name] = score
@@ -145,7 +167,8 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
 
 def _train_loop(booster, params, init_iteration, num_boost_round,
                 callbacks_before_iter, callbacks_after_iter, fobj,
-                feval, valid_sets, is_valid_contain_train):
+                feval, valid_sets, is_valid_contain_train,
+                profile=None):
     evaluation_result_list: List[tuple] = []
     want_eval = valid_sets is not None or feval is not None
     # pipelined evaluation: when every metric evaluates on device
@@ -193,7 +216,11 @@ def _train_loop(booster, params, init_iteration, num_boost_round,
                 end_iteration=end_iteration,
                 evaluation_result_list=None))
 
+        if profile is not None:
+            profile.iter_begin(i - init_iteration + 1)
         booster.update(fobj=fobj)
+        if profile is not None:
+            profile.iter_end(i - init_iteration + 1)
 
         handles = (booster.eval_dispatch_async(is_valid_contain_train)
                    if pipelined else None)

@@ -320,6 +320,8 @@ class TpuDataset:
             if i in done:
                 continue
             bins[:, i] = self.mappers[i].value_to_bin(X[:, real]).astype(dtype)
+        from ..obs import registry as obs
+        obs.counter("ingest/rows_host").add(n)
         return bins
 
     def bin_dtype(self):

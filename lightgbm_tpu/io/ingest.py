@@ -63,6 +63,7 @@ from typing import List, Sequence
 
 import numpy as np
 
+from ..obs import registry as obs
 from ..utils import log, timing
 from .binning import BinMapper, BinType, MissingType
 
@@ -379,8 +380,12 @@ class DeviceBinner:
         rows)."""
         import jax
         (xa, xb, nan, cat_iv), k = prepped
+        nbytes = sum(int(a.nbytes) for a in (xa, xb, nan, cat_iv))
         with timing.phase("binning/device_xfer"):
             xa, xb, nan, cat_iv = jax.device_put((xa, xb, nan, cat_iv))
+        obs.counter("ingest/h2d_bytes").add(nbytes)
+        obs.counter("ingest/h2d_chunks").add(1)
+        obs.counter("ingest/rows_device").add(k)
         out = self._chunk_fn(xa, xb, nan, cat_iv)
         if k < self.chunk_rows:
             out = out[:, :k]

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..config import Config
+from ..obs import registry as obs
 from ..utils import log
 from .dataset import Metadata, TpuDataset
 from .file_io import open_file
@@ -321,6 +322,8 @@ class DatasetLoader:
                 stream.feed(Xf)
             else:
                 bins[row:row + k] = ds.bin_rows(Xf)
+            obs.counter("loader/two_round_blocks").add(1)
+            obs.counter("loader/two_round_rows").add(k)
             row += k
 
         for ln in self._data_lines(filename):

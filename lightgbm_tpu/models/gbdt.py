@@ -162,6 +162,12 @@ class GBDT:
             # blocks at phase exit so upload/ingest device time is
             # attributed here, not to the first training iteration.
             self._bins_dev = ph.watch(jnp.asarray(bins_t))
+        if isinstance(bins_t, np.ndarray):
+            # host->device bulk upload (the streamed-ingest path never
+            # builds a host matrix, so nothing to count there)
+            from ..obs import registry as obs
+            obs.counter("transfer/h2d_bins_bytes").add(int(bins_t.nbytes))
+            obs.counter("transfer/h2d_uploads").add(1)
         self._train_width = bins_t.shape[1]
         self._valid_row_slices: List[tuple] = []
         self._n_total = self._n + self._pad_rows
@@ -495,7 +501,11 @@ class GBDT:
             # train set — io/dataset.py _device_ingest_ok)
             vb = valid_data.bins_t_dev
         else:
-            vb = jnp.asarray(np.ascontiguousarray(v_host.T))
+            vt = np.ascontiguousarray(v_host.T)
+            from ..obs import registry as obs
+            obs.counter("transfer/h2d_bins_bytes").add(int(vt.nbytes))
+            obs.counter("transfer/h2d_uploads").add(1)
+            vb = jnp.asarray(vt)
         self._valid_bins_dev.append(vb)
         for t_idx, rec in enumerate(self.records):
             cls = t_idx % self.num_tree_per_iteration
@@ -1271,8 +1281,18 @@ class GBDT:
         per-iteration metric output (OutputMetric, gbdt.cpp:466-534),
         reference-style early stopping (EvalAndCheckEarlyStopping,
         gbdt.cpp:432-448: pop the last ``early_stopping_round``
-        iterations on stop), and periodic snapshots."""
+        iterations on stop), and periodic snapshots.
+
+        Telemetry seam (obs/): every iteration is spanned by a
+        RunRecorder (wall time, HBM, transfer-byte deltas, eval values;
+        per-iteration leaf counts are filled at the end from ONE
+        stacked download), the slow-iteration watchdog warns with the
+        phase table, and tpu_profile_dir/tpu_profile_iters bracket a
+        configurable iteration window with the jax profiler."""
         import time
+
+        from ..obs.profiler import ProfileWindow
+        from ..obs.recorder import RunRecorder
         cfg = self.config
         # best_score_[i][j] per (valid set, metric), in
         # bigger-is-better orientation
@@ -1281,6 +1301,20 @@ class GBDT:
         self._best_msg = [[""] * len(ms) for ms in self.valid_metrics]
         start_time = time.monotonic()
         is_finished = False
+        recorder = RunRecorder(
+            path=cfg.tpu_run_report,
+            watchdog_factor=cfg.tpu_watchdog_factor,
+            meta={"driver": "gbdt.train", "objective": cfg.objective,
+                  "tree_learner": self._learner_mode,
+                  "num_iterations": cfg.num_iterations,
+                  "num_leaves": cfg.num_leaves,
+                  "wave_size": self._grower_cfg.wave_size,
+                  "num_data": self._n,
+                  "num_features": self.train_data.num_features,
+                  "num_class": self.num_class}).start()
+        self._recorder = recorder
+        profile = ProfileWindow(cfg.tpu_profile_dir,
+                                cfg.tpu_profile_iters)
 
         def materialize_batch(batch):
             """[(it, handles)] -> [(it, {idx: [(name, val, bigger)]})]
@@ -1348,49 +1382,93 @@ class GBDT:
         # iterates config num_iterations times from the loaded state);
         # the log/snapshot index is likewise the ADDITIONAL-round
         # counter (gbdt.cpp:255-260 uses its loop-local iter + 1)
-        for add in range(cfg.num_iterations):
-            is_finished = self.train_one_iter()
-            trained = add + 1
-            if not is_finished:
-                it = add + 1
-                handles = (self._eval_dispatch(it) if pipeline_ok
-                           else None)
-                if handles is None:
-                    pipeline_ok = False
-                if pipeline_ok:
-                    pending.append((it, handles))
-                    if len(pending) >= kdepth:
-                        # ONE drain per K rounds: the wait rides the
-                        # already-queued training work, costing ~one
-                        # round-trip per batch instead of per round
-                        is_finished = flush_pending()
-                else:
-                    # drain the lookahead before going synchronous
-                    is_finished = flush_pending()
-                    if not is_finished:
-                        is_finished = \
-                            self._eval_and_check_early_stopping(it)
-            log.info("%f seconds elapsed, finished iteration %d",
-                     time.monotonic() - start_time, add + 1)
-            if snapshot_freq > 0 and (add + 1) % snapshot_freq == 0:
-                # flush the pipelined evals BEFORE snapshotting: a
-                # late-detected early stop pops its lookahead
-                # iterations, and a snapshot written first would
-                # contain trees the pop then removes
+        # groups already present before this loop (continued
+        # training): the report's per-iteration leaf rows must
+        # align with the ADDITIONAL-round numbering used above
+        base_groups = len(self.records) // self.num_tree_per_iteration
+        try:
+            for add in range(cfg.num_iterations):
+                profile.iter_begin(add + 1)
+                recorder.begin_iteration(add + 1)
+                is_finished = self.train_one_iter()
+                # periodic drain/stop-check iterations block on the
+                # device and absorb the queued dispatch backlog — tag
+                # them so the watchdog compares like spans with like
+                sync_iv = self._dispatch_sync_interval
+                drained = ((sync_iv > 0 and self.iter_ % sync_iv == 0)
+                           or self.iter_ % self._stop_check_interval == 0)
+                recorder.end_iteration(
+                    add + 1, kind="sync" if drained else "iter")
+                profile.iter_end(add + 1)
+                trained = add + 1
                 if not is_finished:
-                    is_finished = flush_pending()
-                self.save_model_to_file(
-                    f"{output_model}.snapshot_iter_{add + 1}")
-            if is_finished:
-                break
-        # flush the tail so the last iterations' metric lines (and a
-        # late-detected stop) are not lost
-        flush_pending()
-        self.finish_training()
-        if output_model:
-            with timing.phase("io/save_model"):
-                self.save_model_to_file(output_model)
-            log.info("Finished training; model saved to %s", output_model)
+                    it = add + 1
+                    handles = (self._eval_dispatch(it) if pipeline_ok
+                               else None)
+                    if handles is None:
+                        pipeline_ok = False
+                    if pipeline_ok:
+                        pending.append((it, handles))
+                        if len(pending) >= kdepth:
+                            # ONE drain per K rounds: the wait rides the
+                            # already-queued training work, costing ~one
+                            # round-trip per batch instead of per round
+                            is_finished = flush_pending()
+                    else:
+                        # drain the lookahead before going synchronous
+                        is_finished = flush_pending()
+                        if not is_finished:
+                            is_finished = \
+                                self._eval_and_check_early_stopping(it)
+                log.info("%f seconds elapsed, finished iteration %d",
+                         time.monotonic() - start_time, add + 1)
+                if snapshot_freq > 0 and (add + 1) % snapshot_freq == 0:
+                    # flush the pipelined evals BEFORE snapshotting: a
+                    # late-detected early stop pops its lookahead
+                    # iterations, and a snapshot written first would
+                    # contain trees the pop then removes
+                    if not is_finished:
+                        is_finished = flush_pending()
+                    self.save_model_to_file(
+                        f"{output_model}.snapshot_iter_{add + 1}")
+                if is_finished:
+                    break
+            # flush the tail so the last iterations' metric lines (and a
+            # late-detected stop) are not lost
+            flush_pending()
+            profile.close()
+            self.finish_training()
+            if output_model:
+                with timing.phase("io/save_model"):
+                    self.save_model_to_file(output_model)
+                log.info("Finished training; model saved to %s", output_model)
+            # run report: per-iteration leaf counts come from ONE stacked
+            # download of the surviving records; wave counts derive from
+            # them (a W-leaf wave pass grows up to W leaves per tree).
+            # finish() snapshots the phase table BEFORE log_report resets.
+            self._recorder = None
+            leaves = waves = None
+            K = self.num_tree_per_iteration
+            # the stacked download is only paid when a report will
+            # actually be written (it is a blocking device->host
+            # transfer — ~a full tunnel round-trip on RPC backends)
+            if cfg.tpu_run_report and len(self.records) > base_groups * K:
+                nl = self._num_leaves_host(self.records[base_groups * K:])
+                leaves = nl.reshape(-1, K).tolist()
+                W = max(self._grower_cfg.wave_size, 1)
+                waves = [sum(max(-(-(int(l) - 1) // W), 1) for l in grp)
+                         for grp in leaves]
+            recorder.finish(
+                leaves_per_iteration=leaves, waves_per_iteration=waves,
+                extra={"trained_iterations": self.iter_,
+                       "stopped_early": bool(self._stopped)})
+        finally:
+            # exception path: close an open trace, write the partial
+            # report, clear the log prefix (finish() is idempotent —
+            # the normal path above already finished with leaf counts)
+            profile.close()
+            self._recorder = None
+            recorder.finish(extra={"aborted": True})
         timing.log_report("training phase timings "
                           "(serial_tree_learner.cpp:14-41 analog)")
 
@@ -1452,9 +1530,15 @@ class GBDT:
         es_round = cfg.early_stopping_round
 
         def evals(idx):
-            if values is not None:
-                return values.get(idx, [])
-            return self.get_eval_at(idx)
+            out = (values.get(idx, []) if values is not None
+                   else self.get_eval_at(idx))
+            rec = getattr(self, "_recorder", None)
+            if rec is not None and out:
+                dname = ("training" if idx == 0
+                         else self.valid_names[idx - 1])
+                for name, val, _ in out:
+                    rec.record_eval(it, dname, name, val)
+            return out
 
         ret = ""
         msg_lines: List[str] = []

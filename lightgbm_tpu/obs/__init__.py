@@ -1,0 +1,26 @@
+"""Observability subsystem: metrics registry, run reports, profiling.
+
+- ``obs.registry`` — thread-safe counters/gauges/histograms/timers; the
+  phase accounting in utils/timing.py stores here, the ingest pipeline
+  counts transfer bytes here (io/ingest.py), and everything lands in
+  the run report.
+- ``obs.recorder`` — per-iteration RunRecorder + the versioned
+  JSON/JSONL run-report artifact (config ``tpu_run_report``), the
+  slow-iteration watchdog (``tpu_watchdog_factor``), and the
+  ``[t+12.3s it=140]`` log prefix.
+- ``obs.profiler`` — jax profiler integration: TraceAnnotation wrapping
+  for timing phases and the ``tpu_profile_dir``/``tpu_profile_iters``
+  iteration-window trace bracket.
+
+Only the registry is imported eagerly (utils/timing.py depends on it at
+module load); recorder/profiler import jax-adjacent modules and load on
+first use.
+"""
+from . import registry
+from .registry import (MetricsRegistry, counter, default_registry, gauge,
+                       histogram, timer)
+
+__all__ = [
+    "registry", "MetricsRegistry", "default_registry",
+    "counter", "gauge", "histogram", "timer",
+]

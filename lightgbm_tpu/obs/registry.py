@@ -1,0 +1,299 @@
+"""Thread-safe metrics registry: counters, gauges, histograms, timers.
+
+The single accumulation point for the engine's telemetry. The timing
+module (utils/timing.py) feeds its per-phase wall clocks into the timer
+domain instead of private dicts, the ingest pipeline (io/ingest.py)
+counts host->device transfer bytes from its worker thread, and the
+RunRecorder (obs/recorder.py) snapshots everything into the run report.
+
+Design constraints:
+
+- **Thread-safe.** The ingest prefetch worker records transfer counters
+  and phase times from off-thread while the main thread accumulates
+  training phases; every instrument mutation and every get-or-create
+  takes the owning registry's lock. The lock is per-registry, not
+  per-instrument: contention is negligible at telemetry rates and one
+  lock keeps snapshot() atomic across domains.
+- **Dependency-free.** This module imports only the standard library —
+  utils/timing.py imports it at module load, so it must not import jax,
+  numpy, or anything else from this package.
+- **Plain monotonic time.** Durations are recorded by callers from
+  ``time.monotonic()`` deltas; the registry itself never reads clocks.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Timer", "MetricsRegistry",
+    "default_registry", "counter", "gauge", "histogram", "timer",
+]
+
+
+class Counter:
+    """Monotonically increasing count (events, bytes, rows)."""
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.RLock):
+        self._lock = lock
+        self._value = 0
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    inc = add
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-written value (HBM in use, queue depth)."""
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.RLock):
+        self._lock = lock
+        self._value: Optional[float] = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> Optional[float]:
+        with self._lock:
+            return self._value
+
+
+# default histogram buckets: exponential, sized for seconds-grade
+# durations (1 ms .. 60 s) but serviceable for any positive magnitude
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 25.0, 60.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile readout.
+
+    Buckets are upper bounds (cumulative style); one implicit overflow
+    bucket catches everything above the last bound. ``percentile``
+    returns the upper bound of the bucket containing the requested
+    rank (the observed max for the overflow bucket) — coarse by
+    construction, stable under concurrency, no per-sample storage.
+    """
+    __slots__ = ("_lock", "buckets", "_counts", "_count", "_sum",
+                 "_min", "_max")
+
+    def __init__(self, lock: threading.RLock,
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self._lock = lock
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            i = 0
+            for i, b in enumerate(self.buckets):       # noqa: B007
+                if v <= b:
+                    break
+            else:
+                i = len(self.buckets)
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Upper bound of the bucket holding the q-quantile rank
+        (0 < q <= 1); None when empty."""
+        with self._lock:
+            if not self._count:
+                return None
+            rank = max(1, int(q * self._count + 0.999999))
+            cum = 0
+            for i, c in enumerate(self._counts):
+                cum += c
+                if cum >= rank:
+                    return (self.buckets[i] if i < len(self.buckets)
+                            else self._max)
+            return self._max
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            out = {"count": self._count, "sum": round(self._sum, 9),
+                   "min": self._min, "max": self._max,
+                   "buckets": {str(b): c for b, c in
+                               zip(self.buckets, counts) if c},
+                   "overflow": counts[-1]}
+        for q, name in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            out[name] = self.percentile(q)
+        return out
+
+
+class Timer:
+    """Accumulated duration: total seconds, call count, max call —
+    the phase-table instrument (utils/timing.py feeds these)."""
+    __slots__ = ("_lock", "_total", "_count", "_max")
+
+    def __init__(self, lock: threading.RLock):
+        self._lock = lock
+        self._total = 0.0
+        self._count = 0
+        self._max = 0.0
+
+    def add(self, seconds: float) -> None:
+        seconds = float(seconds)
+        with self._lock:
+            self._total += seconds
+            self._count += 1
+            if seconds > self._max:
+                self._max = seconds
+
+    @property
+    def total(self) -> float:
+        with self._lock:
+            return self._total
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._max
+
+
+class MetricsRegistry:
+    """Named instruments in four domains, one lock, atomic snapshot."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._counters: "OrderedDict[str, Counter]" = OrderedDict()
+        self._gauges: "OrderedDict[str, Gauge]" = OrderedDict()
+        self._histograms: "OrderedDict[str, Histogram]" = OrderedDict()
+        self._timers: "OrderedDict[str, Timer]" = OrderedDict()
+
+    # -- get-or-create accessors --------------------------------------------
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(self._lock)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(self._lock)
+            return g
+
+    def histogram(self, name: str,
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS
+                  ) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(self._lock,
+                                                       buckets)
+            return h
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = self._timers[name] = Timer(self._lock)
+            return t
+
+    # -- reads ---------------------------------------------------------------
+
+    def timer_items(self) -> List[Tuple[str, float, int, float]]:
+        """[(name, total_s, calls, max_s)] — one consistent read."""
+        with self._lock:
+            return [(n, t._total, t._count, t._max)
+                    for n, t in self._timers.items()]
+
+    def counter_items(self) -> Dict[str, int]:
+        with self._lock:
+            return {n: c._value for n, c in self._counters.items()}
+
+    def snapshot(self) -> dict:
+        """JSON-able state of every instrument (the run-report body)."""
+        with self._lock:
+            counters = {n: c._value for n, c in self._counters.items()}
+            gauges = {n: g._value for n, g in self._gauges.items()
+                      if g._value is not None}
+            hists = list(self._histograms.items())
+            phases = {n: {"total_s": round(t._total, 6),
+                          "calls": t._count,
+                          "max_s": round(t._max, 6)}
+                      for n, t in self._timers.items()}
+        return {"counters": counters, "gauges": gauges,
+                "histograms": {n: h.snapshot() for n, h in hists},
+                "phases": phases}
+
+    # -- resets --------------------------------------------------------------
+
+    def reset_timers(self) -> None:
+        """Clear the phase/timer domain only (timing.reset: each phase
+        report covers one run's deltas; counters keep accumulating)."""
+        with self._lock:
+            self._timers.clear()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._timers.clear()
+
+
+# process-global default registry: the engine's instruments all live
+# here unless a caller (tests) builds a private MetricsRegistry
+_default = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _default
+
+
+def counter(name: str) -> Counter:
+    return _default.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _default.gauge(name)
+
+
+def histogram(name: str,
+              buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+    return _default.histogram(name, buckets)
+
+
+def timer(name: str) -> Timer:
+    return _default.timer(name)

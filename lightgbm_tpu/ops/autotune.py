@@ -44,6 +44,7 @@ import math
 import os
 from typing import Callable, Dict, List, Optional
 
+from ..obs import registry as obs
 from ..utils import log, timing
 
 # ---------------------------------------------------------------------------
@@ -313,6 +314,7 @@ class Autotuner:
         ck = self.cache.key_string(kernel, key)
         hit = self.cache.get(ck)
         if hit is not None and hit.get("choice") in candidates:
+            obs.counter("autotune/cache_hits").add(1)
             return hit["choice"]
         timings_ms: Dict[str, float] = {}
         best_c, best_t = None, float("inf")
@@ -334,6 +336,7 @@ class Autotuner:
                         " default %s", kernel, default)
             return default if default is not None else candidates[0]
         self.cache.put(ck, {"choice": best_c, "timings_ms": timings_ms})
+        obs.counter("autotune/tuned_keys").add(1)
         log.info("autotune[%s]: chose %s (%.3f ms; %d candidates timed)",
                  kernel, best_c, best_t * 1e3, len(timings_ms))
         return best_c

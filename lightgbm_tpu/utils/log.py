@@ -3,10 +3,19 @@
 TPU-native counterpart of the reference logger (reference:
 include/LightGBM/utils/log.h:22-105): Debug/Info/Warning levels plus a
 Fatal that raises instead of aborting the process.
+
+Thread-safe: level, callback and run-context are read/written under a
+module lock (the ingest prefetch worker logs from off-thread while the
+main thread may be re-routing output via ``set_callback``). While a
+RunRecorder is active (obs/recorder.py) it installs a *run context*
+provider and every line gains a ``[t+12.3s it=140]`` prefix — run
+elapsed seconds and current boosting iteration — so interleaved lines
+from worker threads stay attributable to a point in the run.
 """
 from __future__ import annotations
 
 import sys
+import threading
 from enum import IntEnum
 
 
@@ -21,32 +30,59 @@ class LightGBMError(RuntimeError):
     """Raised where the reference calls Log::Fatal (utils/log.h:83)."""
 
 
+_lock = threading.Lock()
 _current_level = LogLevel.INFO
 _callback = None
+# zero-arg provider -> (run_elapsed_seconds, iteration-or-None) | None;
+# installed by an active RunRecorder, cleared at finish
+_run_context = None
 
 
 def set_level(level: LogLevel | int) -> None:
     global _current_level
-    _current_level = LogLevel(int(level))
+    with _lock:
+        _current_level = LogLevel(int(level))
 
 
 def get_level() -> LogLevel:
-    return _current_level
+    with _lock:
+        return _current_level
 
 
 def set_callback(cb) -> None:
     """Redirect log output (mirrors Log::ResetCallBack)."""
     global _callback
-    _callback = cb
+    with _lock:
+        _callback = cb
+
+
+def set_run_context(provider) -> None:
+    """Install (or clear, with None) the run-prefix provider."""
+    global _run_context
+    with _lock:
+        _run_context = provider
 
 
 def _write(level: LogLevel, tag: str, msg: str) -> None:
-    if level <= _current_level:
-        line = f"[LightGBM-TPU] [{tag}] {msg}"
-        if _callback is not None:
-            _callback(line + "\n")
-        else:
-            print(line, file=sys.stderr, flush=True)
+    with _lock:
+        lvl, cb, ctx = _current_level, _callback, _run_context
+    if level > lvl:
+        return
+    prefix = ""
+    if ctx is not None:
+        try:
+            rc = ctx()
+        except Exception:               # noqa: BLE001 — the prefix is
+            rc = None                   # decoration, never a failure
+        if rc is not None:
+            elapsed, it = rc
+            prefix = (f"[t+{elapsed:.1f}s"
+                      + (f" it={it}" if it is not None else "") + "] ")
+    line = f"[LightGBM-TPU] [{tag}] {prefix}{msg}"
+    if cb is not None:
+        cb(line + "\n")
+    else:
+        print(line, file=sys.stderr, flush=True)
 
 
 def debug(msg: str, *args) -> None:

@@ -3,22 +3,36 @@
 TPU-native counterpart of the reference's TIMETAG instrumentation
 (reference: src/treelearner/serial_tree_learner.cpp:14-41 init/hist/
 split timers, src/boosting/gbdt.cpp:253-256 per-iteration elapsed).
-A process-global accumulator keyed by phase name; training drivers log
-the table when a run finishes. jax dispatch is async, so a phase's
-bucket holds the HOST time it spent issuing work; queued device time
-lands in whichever later phase first synchronizes. Callers that need
-exact device attribution should block_until_ready inside the phase.
+Phase accumulation lives in the obs metrics registry
+(obs/registry.py) — thread-safe, so the ingest prefetch worker can
+record from off-thread while the main thread accumulates training
+phases — and every phase lands in the run report's phase table
+(obs/recorder.py). jax dispatch is async, so a phase's bucket holds the
+HOST time it spent issuing work; queued device time lands in whichever
+later phase first synchronizes. Callers that need exact device
+attribution ``.watch(out)`` their output (sync at phase exit).
+
+When profiling is active (obs/profiler.py ProfileWindow), each phase
+additionally wraps its block in a ``jax.profiler.TraceAnnotation`` so
+the engine's phase names show up as spans in XLA/Perfetto traces.
 """
 from __future__ import annotations
 
 import time
-from collections import OrderedDict
 from contextlib import contextmanager
 
+from ..obs import registry as _obs
 from . import log
 
-_acc: "OrderedDict[str, float]" = OrderedDict()
-_counts: "OrderedDict[str, int]" = OrderedDict()
+# emit jax TraceAnnotations around phases (toggled by the profiler
+# window; off by default — the annotation objects are cheap but not
+# free, and most runs are not being traced)
+_annotate = False
+
+
+def set_trace_annotations(on: bool) -> None:
+    global _annotate
+    _annotate = bool(on)
 
 
 class _PhaseHandle:
@@ -47,6 +61,14 @@ def phase(name: str):
     phases therefore ``.watch(out)`` their output on the yielded
     handle, which forces completion at phase exit, before the clock
     stops."""
+    ann = None
+    if _annotate:
+        try:
+            import jax
+            ann = jax.profiler.TraceAnnotation(f"lgbm/{name}")
+            ann.__enter__()
+        except Exception:               # noqa: BLE001 — annotation is
+            ann = None                  # an aid, never a failure mode
     t0 = time.monotonic()
     h = _PhaseHandle()
     try:
@@ -54,24 +76,28 @@ def phase(name: str):
     finally:
         if h.out is not None:
             _sync(h.out)
-        _acc[name] = _acc.get(name, 0.0) + (time.monotonic() - t0)
-        _counts[name] = _counts.get(name, 0) + 1
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:           # noqa: BLE001
+                pass
+        _obs.timer(name).add(time.monotonic() - t0)
 
 
 def add(name: str, seconds: float) -> None:
-    _acc[name] = _acc.get(name, 0.0) + seconds
-    _counts[name] = _counts.get(name, 0) + 1
+    _obs.timer(name).add(seconds)
 
 
 def reset() -> None:
-    _acc.clear()
-    _counts.clear()
+    _obs.default_registry().reset_timers()
 
 
 def seconds(prefix: str) -> float:
     """Total accumulated seconds of every phase whose name starts with
     ``prefix`` (e.g. "autotune" sums all per-kernel tuning phases)."""
-    return sum(v for k, v in _acc.items() if k.startswith(prefix))
+    return sum(total for name, total, _, _ in
+               _obs.default_registry().timer_items()
+               if name.startswith(prefix))
 
 
 def _sync(out) -> None:
@@ -89,6 +115,7 @@ def _sync(out) -> None:
     if leaves:
         x = leaves[0]
         np.asarray(x.ravel()[:1] if getattr(x, "ndim", 0) else x)
+        _obs.counter("transfer/d2h_syncs").add(1)
 
 
 def measure(fn, *args, repeats: int = 5, warmup: int = 1) -> float:
@@ -108,17 +135,23 @@ def measure(fn, *args, repeats: int = 5, warmup: int = 1) -> float:
 
 
 def report() -> str:
-    """One line per phase: total seconds, calls, mean ms."""
+    """One line per phase, sorted by total seconds DESCENDING so the
+    dominant phase is always the first line; columns: total, calls,
+    mean, max."""
+    items = sorted(_obs.default_registry().timer_items(),
+                   key=lambda r: -r[1])
     lines = []
-    for name, total in _acc.items():
-        n = max(_counts.get(name, 1), 1)
+    for name, total, n, mx in items:
+        n = max(n, 1)
         lines.append(f"  {name:<24s} {total:9.3f} s  ({n} calls, "
-                     f"{1000.0 * total / n:.2f} ms avg)")
+                     f"{1000.0 * total / n:.2f} ms avg, "
+                     f"{1000.0 * mx:.2f} ms max)")
     return "\n".join(lines)
 
 
 def log_report(header: str = "phase timings") -> None:
     """Log and RESET — each report covers one run's deltas."""
-    if _acc:
-        log.info("%s:\n%s", header, report())
+    body = report()
+    if body:
+        log.info("%s:\n%s", header, body)
         reset()
