@@ -214,20 +214,36 @@ def main():
     # bin_matrix, binning/device_xfer, init/upload_bins, autotune/*,
     # train/step_dispatch, ...) plus the bench/* spans added above —
     # no hand-maintained sub-phase arithmetic to drift
-    report = recorder.finish(extra={
+    # per-iteration psum payloads (data learner): the same accounting
+    # gbdt.train records into its run report, through the same public
+    # helpers (one stacked leaf download drives both)
+    leaves, waves = (g.leaves_and_waves() if g.num_devices > 1
+                     else ([], []))
+    comm = g.record_comm_bytes(recorder, waves) if waves else None
+    # None (JSON null) when accounting is unavailable (serial/voting):
+    # a literal 0 would read as "zero cross-chip bytes"
+    comm_per_iter = round(float(np.mean(comm))) if comm else None
+    report = recorder.finish(
+        leaves_per_iteration=leaves or None,
+        waves_per_iteration=waves or None,
+        extra={
         "train_s": round(train_s, 2), "compile_s": round(compile_s, 2),
+        "mesh_devices": g.num_devices,
+        "comm_bytes_per_iter": comm_per_iter,
         "train_auc": round(float(auc), 5),
         "test_auc": round(float(test_auc), 5)})
     result = {
         "phases": {name: round(rec["total_s"], 2)
                    for name, rec in report["phases"].items()},
         "counters": {k: v for k, v in report["counters"].items()
-                     if k.startswith(("ingest/", "transfer/"))},
+                     if k.startswith(("ingest/", "transfer/", "comm/"))},
         "ingest": "host" if args.no_ingest else "auto",
+        "chips": g.num_devices,
+        "comm_bytes_per_iter": comm_per_iter,
         "metric": ("HIGGS-class GBDT training throughput "
                    f"({args.rows} rows x 28 feat, {args.leaves} leaves, "
                    f"{args.max_bin} bins, {args.iters} iters, "
-                   f"{g._mesh.devices.size if g._mesh is not None else 1}"
+                   f"{g.num_devices}"
                    " chip(s))"),
         "value": round(row_iters_per_s / 1e6, 3),
         "unit": "M row-iters/s",

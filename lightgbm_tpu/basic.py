@@ -705,6 +705,27 @@ class Booster:
     def num_trees(self) -> int:
         return len(self._gbdt.models)
 
+    @property
+    def num_devices(self) -> int:
+        """Devices the training step spans (mesh size for the sharded
+        tree learners, 1 for serial)."""
+        return self._gbdt.num_devices
+
+    @property
+    def learner_mode(self) -> str:
+        """Resolved tree learner (may be 'serial' after fallback)."""
+        return self._gbdt.learner_mode
+
+    def leaves_and_waves(self, start_group: int = 0):
+        """Per-iteration leaf/wave counts (ONE stacked download) —
+        the public reporting surface drivers use (engine/bench)."""
+        return self._gbdt.leaves_and_waves(start_group)
+
+    def record_comm_bytes(self, recorder, waves):
+        """Attach per-iteration psum payload bytes to a RunRecorder
+        (None off the data-parallel path)."""
+        return self._gbdt.record_comm_bytes(recorder, waves)
+
     def num_model_per_iteration(self) -> int:
         return self._gbdt.num_model_per_iteration()
 

@@ -130,6 +130,11 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         key=attrgetter("order"))
 
     booster = Booster(params=params, train_set=train_set)
+    if recorder is not None:
+        # free-form env section of the report: the resolved mesh size
+        # (the learner may have fallen back to serial on one device)
+        recorder.meta["mesh_devices"] = booster.num_devices
+        recorder.meta["tree_learner"] = booster.learner_mode
     if is_valid_contain_train:
         booster.set_train_data_name(train_data_name)
     for valid_set, name in zip(reduced_valid_sets, name_valid_sets):
@@ -155,7 +160,23 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     finally:
         profile.close()
         if recorder is not None:
+            # distributed runs: per-iteration leaf/wave counts and the
+            # psum payload bytes (models/gbdt.py public helpers; one
+            # stacked download, only paid when a report is written)
+            leaves = waves = None
+            try:
+                if init_iteration == 0:
+                    # continued training skips: the recorder's
+                    # iteration keys start at init_iteration + 1 and
+                    # would misalign with the group-0-based lists
+                    leaves, waves = booster.leaves_and_waves()
+                    if waves:
+                        booster.record_comm_bytes(recorder, waves)
+            except Exception:       # noqa: BLE001 — telemetry must
+                pass                # never fail the training result
             recorder.finish(
+                leaves_per_iteration=leaves or None,
+                waves_per_iteration=waves or None,
                 extra={"best_iteration": booster.best_iteration})
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for dataset_name, eval_name, score, _ in evaluation_result_list:

@@ -143,9 +143,15 @@ class TpuDataset:
         self.real_to_inner: dict = {}
         self.bins: Optional[np.ndarray] = None      # [N, F_used]
         # device-resident feature-major bins (io/ingest.py streamed
-        # ingest): [F_used, N] uint8/int32 jax array; exactly one of
-        # bins / bins_t_dev is set after construction
+        # ingest): [F_used, N + bins_t_dev_pad] uint8/int32 jax array;
+        # exactly one of bins / bins_t_dev is set after construction.
+        # When the configured tree learner row-shards (data/voting over
+        # a >1-device mesh) the array is assembled ROW-SHARDED under a
+        # NamedSharding and bins_t_dev_pad holds the zero-bin columns
+        # appended so every shard is the same width (consumers treat
+        # them exactly like the grower's own row padding).
         self.bins_t_dev = None
+        self.bins_t_dev_pad = 0
         self.metadata = Metadata()
         self.feature_names: List[str] = []
         self.max_bin_global = 1
@@ -235,16 +241,35 @@ class TpuDataset:
 
     def _bin_matrix(self, X: np.ndarray, efb_possible: bool = False) -> None:
         """Bin the whole matrix: streamed device ingest (io/ingest.py)
-        when enabled and reproducible, else the host binner."""
+        when enabled and reproducible, else the host binner. Train sets
+        of a row-sharding learner assemble the bins directly under the
+        mesh's NamedSharding (no single-device staging)."""
         self.bins_t_dev = None
+        self.bins_t_dev_pad = 0
         if self._device_ingest_ok(X, efb_possible):
-            from .ingest import DeviceBinner, IngestUnsupported
+            from .ingest import (DeviceBinner, IngestUnsupported,
+                                 ingest_mesh)
             try:
                 binner = DeviceBinner(self.mappers, self.used_feature_map,
                                       self.config, X.dtype)
             except IngestUnsupported as e:
                 log.debug("device ingest unavailable (%s); host binner", e)
             else:
+                # valid sets ride as passenger columns of the grower
+                # matrix (models/gbdt.py) — only the train set's rows
+                # are worth sharding at ingest time
+                mesh = (ingest_mesh(self.config)
+                        if self._reference is None else None)
+                if mesh is not None:
+                    self.bins_t_dev = binner.bin_matrix_sharded(X, mesh)
+                    self.bins_t_dev_pad = (self.bins_t_dev.shape[1]
+                                           - self.num_data)
+                    self.bins = None
+                    log.info("sharded device ingest: %d rows binned "
+                             "across %d device(s) in %d-row chunks",
+                             self.num_data, mesh.devices.size,
+                             binner.chunk_rows)
+                    return
                 self.bins_t_dev = binner.bin_matrix(X)
                 self.bins = None
                 log.info("streamed device ingest: %d rows binned on "
@@ -302,7 +327,7 @@ class TpuDataset:
             log.info("materializing device-binned matrix on host "
                      "(%d rows)", self.num_data)
             return np.ascontiguousarray(
-                np.asarray(self.bins_t_dev).T).astype(
+                np.asarray(self.bins_t_dev)[:, :self.num_data].T).astype(
                 self.bin_dtype(), copy=False)
         return self.bins
 

@@ -24,7 +24,13 @@ a streaming pass that bins rows as they arrive):
   upload disappear from the critical path;
 - the feature-major ``[F, N]`` ``bins_t`` matrix is assembled directly
   on device (one concatenate over chunk outputs), which is exactly the
-  layout the wave grower consumes (models/gbdt.py).
+  layout the wave grower consumes (models/gbdt.py);
+- when the configured tree learner row-shards (``tree_learner`` data /
+  voting over a >1-device mesh), ``bin_matrix_sharded`` round-robins
+  the chunk pipeline ACROSS the mesh and assembles the matrix directly
+  under the grower's ``NamedSharding`` — each device receives and bins
+  only its own contiguous row block, so no single-device staging copy
+  of the dataset ever exists (Design.md §7).
 
 Exactness
 ---------
@@ -88,6 +94,20 @@ def ingest_enabled(config) -> bool:
         return True
     from ..utils.device import on_tpu
     return on_tpu()
+
+
+def ingest_mesh(config):
+    """The device mesh sharded ingest should target, or None for the
+    single-device pipeline: the configured tree learner must row-shard
+    (data/voting) over more than one device. Uses the SAME mesh
+    construction as the learners (parallel/learners.py make_mesh), so
+    the [F, N] bins land exactly where the shard_mapped grower will
+    read them — no single-device staging, no per-iteration reshard."""
+    if getattr(config, "tree_learner", "serial") not in ("data",
+                                                         "voting"):
+        return None
+    from ..parallel.learners import training_mesh
+    return training_mesh(config)
 
 
 def mappers_supported(mappers: Sequence[BinMapper]) -> bool:
@@ -215,6 +235,10 @@ class DeviceBinner:
         self.out_dtype = np.uint8 if max_bin_global <= 256 else np.int32
         self.chunk_rows = auto_chunk_rows(config, len(mappers),
                                           x_dtype.itemsize)
+        # explicit Pallas row chunk, when the operator pinned one —
+        # lets sharded ingest align shards to the exact chunk the
+        # grower will use instead of the 32k candidate superset
+        self.hist_chunk = int(getattr(config, "tpu_hist_chunk", 0) or 0)
 
         # numerical tables: per-feature search range r, NaN bin, and the
         # bound keys padded to a power of two with the max key (never
@@ -374,15 +398,17 @@ class DeviceBinner:
             cat_iv = np.zeros((C, 0), np.int32)
         return (xa, xb, nan, cat_iv), k
 
-    def _submit(self, prepped):
+    def _submit(self, prepped, device=None):
         """Main-thread half: async transfer + kernel dispatch. Returns
         the [F, k] device block (tail chunks sliced to their true
-        rows)."""
+        rows). ``device`` pins the transfer AND the kernel to one mesh
+        device (sharded ingest); None = the default device."""
         import jax
         (xa, xb, nan, cat_iv), k = prepped
         nbytes = sum(int(a.nbytes) for a in (xa, xb, nan, cat_iv))
         with timing.phase("binning/device_xfer"):
-            xa, xb, nan, cat_iv = jax.device_put((xa, xb, nan, cat_iv))
+            xa, xb, nan, cat_iv = jax.device_put(
+                (xa, xb, nan, cat_iv), device)
         obs.counter("ingest/h2d_bytes").add(nbytes)
         obs.counter("ingest/h2d_chunks").add(1)
         obs.counter("ingest/rows_device").add(k)
@@ -410,6 +436,91 @@ class DeviceBinner:
         bins_t = outs[0] if len(outs) == 1 else jnp.concatenate(outs, 1)
         log.debug("device ingest: %d rows x %d features in %d chunk(s) "
                   "of %d rows", n, len(self.mappers), len(outs), C)
+        return bins_t
+
+    def bin_matrix_sharded(self, X: np.ndarray, mesh):
+        """Whole in-memory matrix -> ROW-SHARDED [F, N_pad] device bins
+        under ``NamedSharding(mesh, P(None, AXIS))``, assembled with NO
+        single-device staging: device d owns the contiguous global row
+        block [d*S, (d+1)*S) (S = ceil(N/D); tail rows of the last
+        shard are zero bins, the same values row padding would write),
+        its chunks stream host->device pinned to d, and the chunk
+        submission round-robins ACROSS devices so every chip's transfer
+        + bin kernel overlap the next chip's host prep. Bit-exact with
+        ``bin_matrix``: the identical compiled chunk kernel maps the
+        identical row slices — only the destination device differs.
+
+        Returns a jax.Array whose trailing ``N_pad - N`` columns are
+        padding (the caller records the true row count)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.learners import AXIS
+
+        devs = list(mesh.devices.reshape(-1))
+        D = len(devs)
+        n = X.shape[0]
+        C = self.chunk_rows
+        S = max(-(-n // D), 1)
+        # align each shard to the grower's row chunk so _setup_grower
+        # ADOPTS this padding instead of re-padding + resharding the
+        # whole mesh-resident matrix: the pinned tpu_hist_chunk when
+        # set, else the LARGEST power-of-two unit u <= MAX_HIST_CHUNK
+        # (the autotune candidate ceiling, exhaustive tier included)
+        # with n >= 4*D*u — the grower only chunk-aligns when
+        # n >= 4*D*kchunk, so every kchunk it can align to satisfies
+        # kchunk <= u and (both powers of two) divides S; pad stays
+        # <= S/4 by the same bound
+        from ..ops.autotune import MAX_HIST_CHUNK
+        if self.hist_chunk > 0:
+            u = self.hist_chunk if n >= 4 * D * self.hist_chunk else 1
+        else:
+            u = 1
+            while u * 2 <= MAX_HIST_CHUNK and n >= 4 * D * (u * 2):
+                u *= 2
+        if u > 1:
+            S = -(-S // u) * u
+
+        # interleaved (device, row-slice) submission order: chunk k of
+        # every shard before chunk k+1 of any — the round-robin that
+        # keeps all D transfer queues busy while ONE prefetch worker
+        # preps ahead in the same order
+        tasks = []   # (device index, start row, rows)
+        max_chunks = -(-S // C)
+        for k in range(max_chunks):
+            for d in range(D):
+                r0 = d * S + k * C
+                r1 = min(d * S + S, n, r0 + C)
+                if r0 < min(d * S + S, n):
+                    tasks.append((d, r0, r1 - r0))
+
+        def thunk(t):
+            d, r0, rows = t
+            return lambda: (d, self._prep_chunk(X[r0:r0 + rows]))
+
+        per_dev = [[] for _ in range(D)]
+        for prepped in prefetch(thunk(t) for t in tasks):
+            d, p = prepped
+            per_dev[d].append(self._submit(p, device=devs[d]))
+
+        shards = []
+        for d in range(D):
+            rows_d = max(min(S, n - d * S), 0)
+            parts = per_dev[d]
+            if rows_d < S:
+                # zero-bin tail (row padding): committed to device d so
+                # the assembled shard never leaves it
+                parts.append(jax.device_put(
+                    jnp.zeros((len(self.mappers), S - rows_d),
+                              self.out_dtype), devs[d]))
+            shards.append(parts[0] if len(parts) == 1
+                          else jnp.concatenate(parts, axis=1))
+        sharding = NamedSharding(mesh, P(None, AXIS))
+        bins_t = jax.make_array_from_single_device_arrays(
+            (len(self.mappers), D * S), sharding, shards)
+        log.debug("sharded device ingest: %d rows x %d features over "
+                  "%d device(s) (%d-row shards, %d-row chunks)",
+                  n, len(self.mappers), D, S, C)
         return bins_t
 
     def start_stream(self) -> "IngestStream":

@@ -120,10 +120,24 @@ class GBDT:
         if dev_bins is not None:
             # streamed ingest (io/ingest.py): the bins are already
             # device-resident in the grower's [F, N] layout — pad and
-            # nibble-pack on device; no host matrix ever existed
+            # nibble-pack on device; no host matrix ever existed. A
+            # sharded ingest already carries (-n) % D zero-bin pad
+            # columns; only the difference up to this learner's row
+            # alignment is padded here (and surplus pad is sliced off
+            # if a re-init changed the learner mode).
             bins_t = dev_bins
-            if self._pad_rows:
-                bins_t = jnp.pad(bins_t, ((0, 0), (0, self._pad_rows)))
+            ingest_pad = getattr(train_data, "bins_t_dev_pad", 0)
+            extra = self._pad_rows - ingest_pad
+            if extra > 0:
+                if self._mesh is not None and ingest_pad:
+                    # adoption missed (ingest's alignment guess vs the
+                    # tuned chunk): one-time full-matrix re-layout
+                    log.info("sharded ingest pad %d < grower pad %d: "
+                             "re-padding the mesh-resident bins once "
+                             "at init", ingest_pad, self._pad_rows)
+                bins_t = jnp.pad(bins_t, ((0, 0), (0, extra)))
+            elif extra < 0:
+                bins_t = bins_t[:, :self._n + self._pad_rows]
             if self._pad_features:
                 bins_t = jnp.pad(bins_t,
                                  ((0, self._pad_features), (0, 0)))
@@ -161,7 +175,11 @@ class GBDT:
             # a slice view, not a second resident copy. The watch
             # blocks at phase exit so upload/ingest device time is
             # attributed here, not to the first training iteration.
-            self._bins_dev = ph.watch(jnp.asarray(bins_t))
+            # Sharded learners place the matrix under the mesh's
+            # NamedSharding HERE, once — the jitted step then sees
+            # inputs already laid out as its shard_map wants them and
+            # never pays a per-iteration reshard.
+            self._bins_dev = ph.watch(self._place_bins(bins_t))
         if isinstance(bins_t, np.ndarray):
             # host->device bulk upload (the streamed-ingest path never
             # builds a host matrix, so nothing to count there)
@@ -171,7 +189,7 @@ class GBDT:
         self._train_width = bins_t.shape[1]
         self._valid_row_slices: List[tuple] = []
         self._n_total = self._n + self._pad_rows
-        self._full_mask_dev = jnp.asarray(np.concatenate(
+        self._full_mask_dev = self._place_rows(np.concatenate(
             [np.ones(self._n, np.float32),
              np.zeros(self._pad_rows, np.float32)]))
         self._init_scores()
@@ -212,16 +230,18 @@ class GBDT:
 
         # distributed learner selection (tree_learner.cpp:9-33 analog):
         # tree_learner = serial|feature|data|voting over the device mesh
-        from ..parallel.learners import make_grower_for_mode, make_mesh
+        from ..parallel.learners import (make_grower_for_mode,
+                                         training_mesh)
         mode = cfg.tree_learner
-        want = cfg.num_machines if cfg.num_machines > 1 else None
         mesh = None
         if mode != "serial":
-            mesh = make_mesh(want)
-            if mesh.devices.size == 1:
+            # same policy sharded ingest used (learners.training_mesh),
+            # so the bins are already under this exact mesh
+            mesh = training_mesh(cfg)
+            if mesh is None:
                 log.warning("tree_learner=%s requested but only one device"
                             " is available; falling back to serial", mode)
-                mesh, mode = None, "serial"
+                mode = "serial"
         self._mesh = mesh
         self._learner_mode = mode
         D = mesh.devices.size if mesh is not None else 1
@@ -337,6 +357,16 @@ class GBDT:
                 # per-shard fused kernel re-pads otherwise); small test
                 # datasets skip this (padding would dwarf the data)
                 self._pad_rows = (-self._n) % (D * kchunk)
+            ing = getattr(self.train_data, "bins_t_dev_pad", 0)
+            if ing > self._pad_rows:
+                unit = (D * kchunk if self._n >= 4 * D * kchunk else D)
+                if (self._n + ing) % unit == 0:
+                    # sharded ingest already padded wider (32k-aligned
+                    # shards) AND its width satisfies this learner's
+                    # alignment — adopt it wholesale: the matrix is
+                    # mesh-resident at that width, and re-padding
+                    # would reshard every shard boundary
+                    self._pad_rows = ing
         elif mode == "serial":
             from ..utils.device import on_tpu
             if on_tpu():
@@ -364,6 +394,29 @@ class GBDT:
         self._n_pad = self._n + self._pad_rows
         self._f_pad = f + self._pad_features
 
+        # quantized histogram reduction (tpu_quantized_psum): on the
+        # data-parallel path the wave-histogram psum carries the RAW
+        # int32 quantized representation and dequantizes after the
+        # collective — exact integer addition on the wire and, with the
+        # count-proxy tier, a 2-channel payload. Needs the default
+        # seams (no EFB hist_fn) and global scales (already pmax'd);
+        # the int-vs-f32 wire choice is autotuned on real meshes
+        # (ops/autotune.py tune_hist_psum).
+        quant_psum = False
+        if (quant and mode == "data" and mesh is not None
+                and not self._use_bundles):
+            from ..ops.autotune import tune_hist_psum
+            quant_psum = tune_hist_psum(
+                mesh=mesh, W=W, F=f,
+                B=max(self.train_data.max_bin_global, 2),
+                channels=2 if proxy else 3,
+                n_rows_global=self._n_pad,
+                requested=cfg.tpu_quantized_psum)
+        elif cfg.tpu_quantized_psum == 1:
+            log.warning("tpu_quantized_psum=1 needs tpu_quantized_hist "
+                        "with tree_learner=data on a multi-device mesh "
+                        "and no EFB bundles; using the f32 reduction")
+
         gcfg = WaveGrowerConfig(
             num_leaves=max(cfg.num_leaves, 2),
             # >= 2 so the per-feature split scan is never empty (the
@@ -379,7 +432,8 @@ class GBDT:
             precision=precision,
             forced=self._parse_forced_splits(),
             count_proxy=proxy,
-            packed4=packed4)
+            packed4=packed4,
+            quant_psum=quant_psum)
         self._grower_cfg = gcfg
         hist_fn = None
         efb_feature = None
@@ -416,6 +470,68 @@ class GBDT:
             mode, gcfg, meta, mesh, self._f_pad, cfg.top_k,
             hist_fn=hist_fn, efb_feature=efb_feature)
         self._step_key = None       # grower changed: rebuild fused step
+
+    # -- sharded iteration state (data/voting over a mesh) -------------------
+
+    @property
+    def num_devices(self) -> int:
+        """Devices the training step actually spans: the mesh size for
+        the sharded learners, 1 for serial (public — bench/reporting
+        must not reach into ``_mesh``)."""
+        mesh = getattr(self, "_mesh", None)
+        return int(mesh.devices.size) if mesh is not None else 1
+
+    @property
+    def learner_mode(self) -> str:
+        """Resolved tree learner — may be 'serial' after a one-device
+        fallback, unlike config.tree_learner (public, for reporting)."""
+        return getattr(self, "_learner_mode", "serial")
+
+    def _row_sharded(self) -> bool:
+        """True when iteration state lives row-sharded over the mesh
+        (data/voting): bins [F, N], scores [K, N], grad/hess/bagging
+        masks and leaf ids all partition on the row axis, matching the
+        shard_map specs — so the per-iteration step moves NO data
+        between chips except the wave-histogram psum (and O(N)-vector
+        boundary shuffles where train/valid slices cross shard edges)."""
+        return (self._mesh is not None
+                and self._learner_mode in ("data", "voting"))
+
+    def _named_sharding(self, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel.learners import AXIS
+        spec = tuple(AXIS if s == "rows" else None for s in spec)
+        return NamedSharding(self._mesh, PartitionSpec(*spec))
+
+    def _place_rows(self, x):
+        """[N_total] row vector onto the mesh (P over rows), or the
+        default device for serial."""
+        if not self._row_sharded():
+            return jnp.asarray(x)
+        return jax.device_put(x, self._named_sharding("rows"))
+
+    def _place_bins(self, x):
+        """[F, N_total] bin matrix: feature axis replicated, row axis
+        sharded. device_put of a host matrix distributes each shard
+        straight to its chip; re-placing an already-matching sharded
+        array (the sharded-ingest path) is a no-op."""
+        if not self._row_sharded():
+            return jnp.asarray(x)
+        return jax.device_put(x, self._named_sharding(None, "rows"))
+
+    def _place_scores(self, x):
+        """[K, N] score block, row axis sharded. jax only places
+        explicit shardings on evenly divisible axes, so score blocks
+        whose (unpadded) row count doesn't divide the mesh stay on the
+        default device — the step still computes correctly (GSPMD
+        moves the [N] f32 vectors at the slice boundary), it just
+        pays an O(N)-vector shuffle instead of staying shard-local.
+        Production-scale row counts are D-aligned; tiny test sets may
+        not be."""
+        if (not self._row_sharded()
+                or np.shape(x)[-1] % self.num_devices):
+            return jnp.asarray(x)
+        return jax.device_put(x, self._named_sharding(None, "rows"))
 
     def _parse_forced_splits(self) -> tuple:
         """forcedsplits_filename JSON -> BFS-ordered
@@ -475,7 +591,7 @@ class GBDT:
         md = self.train_data.metadata
         if md.init_score is not None:
             init += np.asarray(md.init_score, np.float32).reshape(k, n)
-        self._scores = jnp.asarray(init)
+        self._scores = self._place_scores(init)
         self._valid_scores: List[jax.Array] = []
 
     def add_valid_data(self, valid_data: TpuDataset,
@@ -488,7 +604,7 @@ class GBDT:
         if valid_data.metadata.init_score is not None:
             init += np.asarray(valid_data.metadata.init_score,
                                np.float32).reshape(k, nv)
-        self._valid_scores.append(jnp.asarray(init))
+        self._valid_scores.append(self._place_scores(init))
         # replay existing model on the new valid set (bins cached on device
         # once — uploads are cheap, downloads are not)
         v_host = (valid_data.bundled_bins
@@ -651,12 +767,16 @@ class GBDT:
         if tail:
             parts.append(jnp.zeros((base.shape[0], tail), base.dtype))
         self._n_total = off + tail
-        self._bins_dev = (parts[0] if len(parts) == 1
-                          else jnp.concatenate(parts, axis=1))
+        # re-place under the mesh sharding: passenger columns arrive on
+        # one device, so the combined matrix reshards ONCE here instead
+        # of every iteration
+        self._bins_dev = self._place_bins(
+            parts[0] if len(parts) == 1
+            else jnp.concatenate(parts, axis=1))
         # masks/scores pad to the new total
-        self._full_mask_dev = jnp.concatenate(
+        self._full_mask_dev = self._place_rows(jnp.concatenate(
             [jnp.ones(self._n, jnp.float32),
-             jnp.zeros(self._n_total - self._n, jnp.float32)])
+             jnp.zeros(self._n_total - self._n, jnp.float32)]))
         self._step_key = None        # step closure holds the slices
 
     def _feature_mask(self) -> np.ndarray:
@@ -857,7 +977,7 @@ class GBDT:
             if tail:
                 mask_np = np.concatenate(
                     [mask_np, np.zeros(tail, np.float32)])
-            mask = jnp.asarray(mask_np)
+            mask = self._place_rows(mask_np)
         fmask = self._feature_mask_dev()
 
         first_iteration = not self.models
@@ -899,6 +1019,57 @@ class GBDT:
             return self._check_stop()
         return False
 
+
+    def leaves_and_waves(self, start_group: int = 0):
+        """Per-iteration [class-tree] leaf counts and wave-pass counts
+        for the stored records from ``start_group`` on — ONE stacked
+        device download. Public: the run report (train) and bench both
+        derive their comm accounting from these."""
+        K = self.num_tree_per_iteration
+        recs = self.records[start_group * K:]
+        if not recs:
+            return [], []
+        nl = self._num_leaves_host(recs)
+        leaves = nl.reshape(-1, K).tolist()
+        W = max(self._grower_cfg.wave_size, 1)
+        waves = [sum(max(-(-(int(l) - 1) // W), 1) for l in grp)
+                 for grp in leaves]
+        return leaves, waves
+
+    def record_comm_bytes(self, recorder, waves) -> Optional[list]:
+        """Attach per-iteration psum payload bytes (and the cumulative
+        comm counters) to a RunRecorder; returns the byte list, or
+        None off the data-parallel path."""
+        comm = self._comm_bytes_per_iteration(waves)
+        if comm:
+            from ..obs import registry as obs
+            for i, cb in enumerate(comm):
+                recorder.set_field(i + 1, "comm_bytes", cb)
+            obs.counter("comm/psum_bytes").add(sum(comm))
+            obs.counter("comm/psum_passes").add(
+                sum(waves) + self.num_tree_per_iteration * len(waves))
+        return comm
+
+    def _comm_bytes_per_iteration(self, waves) -> Optional[list]:
+        """Per-iteration cross-chip psum payload bytes on the
+        data-parallel path (None otherwise): each class tree pays one
+        root histogram pass plus one per wave step, and each pass
+        reduces a [W, F_hist, B, C] block (4-byte entries on either
+        wire — int32 quantized or f32; the count-proxy tier carries 2
+        channels instead of 3). Scalar reductions (root aggregates,
+        quantization pmax) are a few hundred bytes per tree and are
+        not counted."""
+        if self._mesh is None or self._learner_mode != "data":
+            return None
+        gcfg = self._grower_cfg
+        from ..utils.device import on_tpu
+        # the 2-channel proxy wire only exists where the Pallas fused
+        # kernel runs (the XLA oracle keeps 3 exact channels)
+        C = 2 if (gcfg.count_proxy and on_tpu()) else 3
+        F_h = max(self.train_data.num_features, 1)
+        per_pass = gcfg.wave_size * F_h * gcfg.num_bins * C * 4
+        K = self.num_tree_per_iteration
+        return [(int(w) + K) * per_pass for w in waves]
 
     def _num_leaves_host(self, records) -> np.ndarray:
         """Download num_leaves for a list of records in ONE transfer."""
@@ -1306,6 +1477,7 @@ class GBDT:
             watchdog_factor=cfg.tpu_watchdog_factor,
             meta={"driver": "gbdt.train", "objective": cfg.objective,
                   "tree_learner": self._learner_mode,
+                  "mesh_devices": self.num_devices,
                   "num_iterations": cfg.num_iterations,
                   "num_leaves": cfg.num_leaves,
                   "wave_size": self._grower_cfg.wave_size,
@@ -1453,11 +1625,10 @@ class GBDT:
             # actually be written (it is a blocking device->host
             # transfer — ~a full tunnel round-trip on RPC backends)
             if cfg.tpu_run_report and len(self.records) > base_groups * K:
-                nl = self._num_leaves_host(self.records[base_groups * K:])
-                leaves = nl.reshape(-1, K).tolist()
-                W = max(self._grower_cfg.wave_size, 1)
-                waves = [sum(max(-(-(int(l) - 1) // W), 1) for l in grp)
-                         for grp in leaves]
+                leaves, waves = self.leaves_and_waves(base_groups)
+                # cross-chip traffic: every root/wave histogram pass
+                # moves one [W, F, B, C] block through the psum
+                self.record_comm_bytes(recorder, waves)
             recorder.finish(
                 leaves_per_iteration=leaves, waves_per_iteration=waves,
                 extra={"trained_iterations": self.iter_,

@@ -24,6 +24,14 @@ The recorder also owns two run-scoped behaviors:
   armed after 8) logs a warning with the current phase table — the
   in-flight diagnosis for "training suddenly crawls" (retracing, queue
   stalls, host fallback).
+
+Distributed runs ride the same schema (no version bump): the free-form
+``meta`` section carries ``mesh_devices`` (the resolved mesh size) and
+each iteration record gains ``comm_bytes`` — the logical psum payload
+of that iteration's wave-histogram reductions, filled by the driver
+from the end-of-run wave counts (models/gbdt.py
+_comm_bytes_per_iteration) — alongside the cumulative
+``comm/psum_bytes`` / ``comm/psum_passes`` counters.
 """
 from __future__ import annotations
 

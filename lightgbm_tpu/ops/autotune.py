@@ -63,6 +63,10 @@ PALLAS_VMEM_BUDGET_BYTES = 72 * 1024 * 1024
 # fallback for tpu_autotune=off, CPU backends and interpret mode)
 DEFAULT_HIST_CHUNK = 8192
 DEFAULT_HIST_CHUNK_INT8 = 16384
+# largest row chunk any candidate set can offer (the exhaustive tier's
+# ceiling) — sharded ingest aligns its shards against THIS bound so
+# grower pad adoption (models/gbdt.py) holds for every tunable chunk
+MAX_HIST_CHUNK = 65536
 DEFAULT_ROW_TILE = 2048
 
 
@@ -428,8 +432,8 @@ def hist_chunk_candidates(*, F: int, B: int, W: int, fused: bool,
     overflow guard."""
     geom = hist_geometry(F=F, B=B, W=W,
                          F_rows=(F + 1) // 2 if packed4 else F)
-    base = ((1024, 2048, 4096, 8192, 16384, 32768, 65536) if exhaustive
-            else (4096, 8192, 16384, 32768))
+    base = ((1024, 2048, 4096, 8192, 16384, 32768, MAX_HIST_CHUNK)
+            if exhaustive else (4096, 8192, 16384, 32768))
     out = []
     for c in base:
         if n_rows and c > max(n_rows, base[0]):
@@ -484,6 +488,81 @@ def tune_hist_chunk(*, fused: bool, F: int, B: int, W: int,
     choice = t.best("fused_hist" if fused else "wave_hist", key, cands,
                     measure, default={"chunk": default})
     return int(choice["chunk"])
+
+
+# ---------------------------------------------------------------------------
+# Histogram-psum wire-format tuning (data-parallel reduction)
+# ---------------------------------------------------------------------------
+
+def tune_hist_psum(*, mesh, W: int, F: int, B: int, channels: int,
+                   n_rows_global: int, requested: int = -1) -> bool:
+    """Wire format of the data-parallel wave-histogram reduction:
+    True = psum the RAW int32 quantized histogram and dequantize after
+    the collective (exact integer addition across shards, and — with
+    the count-proxy tier — a 2-channel payload instead of 3);
+    False = psum dequantized f32 sums (the pre-quantized-psum wire).
+
+    ``requested`` is config.tpu_quantized_psum (-1 auto / 0 off /
+    1 force). The int32 wire is only sound while the GLOBAL padded row
+    count keeps 127 * n under int32 wrap — beyond that the f32 wire is
+    used regardless (f32 rounds but never wraps). Inside the bound the
+    auto choice is timed once per (mesh size, payload shape, device)
+    key on real TPU meshes and cached; off-TPU (and with
+    tpu_autotune=off) the analytic default — int32 — is used."""
+    if requested == 0:
+        return False
+    from ..utils.device import on_tpu
+    tpu = on_tpu()
+    # off-TPU the "quantized wire" is the XLA oracle's integer-VALUED
+    # f32 sums (hist_wave.wave_histogram), which stay exact only below
+    # 2^24 — the int32 Pallas wire holds to 2^31. Past the applicable
+    # bound the deferred-dequant reduction could round/wrap, so the
+    # dequantize-first f32 wire (rounds, never wraps) is used instead.
+    bound = 2 ** 31 if tpu else 2 ** 24
+    safe = 127 * max(int(n_rows_global), 1) < bound
+    if not safe:
+        if requested == 1:
+            log.warning("tpu_quantized_psum=1 requested but %d global "
+                        "rows could overflow the quantized wire; using "
+                        "the f32 reduction", n_rows_global)
+        return False
+    if requested == 1:
+        return True
+    t = tuner()
+    if t.mode == "off" or not tpu:
+        return True
+    D = int(mesh.devices.size)
+    key = {"D": D, "W": W, "F": F, "B": B, "C": channels,
+           "device": device_kind()}
+    cands = [{"wire": "int32"}, {"wire": "f32"}]
+    choice = t.best("hist_psum", key, cands,
+                    _psum_measure_fn(mesh, (W, F, B, channels)),
+                    default={"wire": "int32"})
+    return choice["wire"] == "int32"
+
+
+def _psum_measure_fn(mesh, shape):
+    """measure(candidate) for the histogram-reduction wire formats: a
+    jitted shard_map psumming a dummy payload of the real [W, F, B, C]
+    block in the candidate's dtype."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    # lazy: parallel.learners imports ops.wave_grower which imports
+    # this module at top level
+    from ..parallel.learners import AXIS, _shard_map
+
+    def build(dtype):
+        def body(x):
+            return jax.lax.psum(x, AXIS)
+        f = jax.jit(_shard_map(body, mesh=mesh, in_specs=(P(),),
+                               out_specs=P(), check_vma=False))
+        x = jnp.ones(shape, dtype)
+        return functools.partial(f, x)
+
+    fns = {"int32": build(jnp.int32), "f32": build(jnp.float32)}
+    return lambda cand: timing.measure(fns[cand["wire"]])
 
 
 def _hist_measure_rows(cands: List[dict], F: int, bins_bytes: int) -> int:

@@ -235,11 +235,13 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "chunk", "interpret",
                                     "precision", "count_proxy",
-                                    "packed4", "num_features"))
+                                    "packed4", "num_features",
+                                    "dequant"))
 def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                           chunk=2048, interpret=False, precision="highest",
                           gh_scale=None, count_proxy=False,
-                          packed4=False, num_features=None):
+                          packed4=False, num_features=None,
+                          dequant=True):
     """Pallas wave histogram — same contract as wave_histogram_xla.
 
     Grid over row chunks; per chunk the kernel builds the leaf-membership
@@ -255,7 +257,10 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     "int8" expects PRE-QUANTIZED integer-valued g/h in [-127, 127]
     (tpu_quantized_hist) and accumulates exactly in int32 at 2x MXU
     rate (W <= 42) — ``gh_scale`` = (g_scale, h_scale) dequantizes the
-    output back to f32 sums.
+    output back to f32 sums. ``dequant=False`` defers that scaling and
+    returns the RAW int32 sums instead (the quantized-psum wire format:
+    the data-parallel learner reduces the integer representation across
+    the mesh and dequantizes after the collective, ops/wave_grower.py).
     """
     F, n = bins_t.shape
     if packed4:
@@ -340,10 +345,14 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
         return out.transpose(3, 0, 1, 2)
     if count_proxy:
         out = out.reshape(F, B, 2, W).transpose(3, 0, 1, 2)
+        if not dequant:
+            return out
         return out.astype(jnp.float32) * jnp.stack(
             [jnp.float32(gh_scale[0]), jnp.float32(gh_scale[1])])
     out = out.reshape(F, B, 3, W).transpose(3, 0, 1, 2)
     if int8:
+        if not dequant:
+            return out
         out = out.astype(jnp.float32) * _qscale_vec(gh_scale)
     return out
 
@@ -357,12 +366,15 @@ def _qscale_vec(gh_scale):
 
 def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                    chunk=0, use_pallas=None, precision="highest",
-                   gh_scale=None, count_proxy=False):
+                   gh_scale=None, count_proxy=False, dequant=True):
     """Dispatch: Pallas on TPU, XLA elsewhere (or force via use_pallas).
 
     precision="int8": g/h are integer-valued (quantized) and gh_scale
     dequantizes the sums; the XLA scatter path is exact on integer
     floats as-is, so only the Pallas kernel switches dtype.
+    ``dequant=False`` skips the scaling (quantized-psum wire format —
+    the XLA oracle then returns integer-VALUED f32 sums, the Pallas
+    kernel raw int32).
     count_proxy: the Pallas kernel returns 2 channels (g, h); the XLA
     oracle still returns 3 exact channels — proxy callers overwrite
     the count channel either way (wave_grower.bound_counts)."""
@@ -374,11 +386,11 @@ def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
             bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
             chunk=chunk or autotune.DEFAULT_HIST_CHUNK,
             precision=precision, gh_scale=gh_scale,
-            count_proxy=count_proxy)
+            count_proxy=count_proxy, dequant=dequant)
     out = wave_histogram_xla(
         bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
         chunk=0, precision="highest")
-    if precision == "int8":
+    if precision == "int8" and dequant:
         out = out * _qscale_vec(gh_scale)
     return out
 
@@ -641,14 +653,15 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk",
                                              "interpret", "precision",
                                              "any_cat", "count_proxy",
-                                             "packed4", "num_features"))
+                                             "packed4", "num_features",
+                                             "dequant"))
 def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
                                      leaf_ids, tbl, *, num_bins,
                                      chunk=2048, interpret=False,
                                      precision="highest",
                                      gh_scale=None, any_cat=True,
                                      count_proxy=False, packed4=False,
-                                     num_features=None):
+                                     num_features=None, dequant=True):
     """Partition one wave + build its smaller-child histograms in ONE
     data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]) — or, with
     ``count_proxy``, (new_leaf_ids, hist [W, F, B, 2], cnt_right [W]).
@@ -660,7 +673,10 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
 
     precision="int8": g/h are pre-quantized integer-valued floats
     (tpu_quantized_hist); sums accumulate exactly in int32 at 2x MXU
-    rate and ``gh_scale`` dequantizes the output.
+    rate and ``gh_scale`` dequantizes the output. ``dequant=False``
+    returns the histogram in its RAW int32 representation instead —
+    the quantized-psum wire format the data-parallel learner reduces
+    across the mesh before dequantizing (ops/wave_grower.py).
 
     count_proxy (int8 only): drop the count channel from the MXU dot
     (2 channels x W <= 128 -> waves up to 64 wide, fewer full-data
@@ -771,14 +787,17 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
         groups * group_sz, Bp, nchan * W)[:F, :B]
     hist = hist.reshape(F, B, nchan, W)
     if count_proxy:
-        hist = hist.astype(jnp.float32).transpose(0, 1, 3, 2) \
-            * jnp.stack([jnp.float32(gh_scale[0]),
-                         jnp.float32(gh_scale[1])])        # [F,B,W,2]
+        hist = hist.transpose(0, 1, 3, 2)                  # [F,B,W,2]
+        if dequant:
+            hist = hist.astype(jnp.float32) \
+                * jnp.stack([jnp.float32(gh_scale[0]),
+                             jnp.float32(gh_scale[1])])
         return (leaf_out[0, :n], hist.transpose(2, 0, 1, 3),
                 outs[2][:W, 0])
     if int8:
-        hist = hist.astype(jnp.float32).transpose(0, 1, 3, 2) \
-            * _qscale_vec(gh_scale)                        # [F,B,W,3]
+        hist = hist.transpose(0, 1, 3, 2)                  # [F,B,W,3]
+        if dequant:
+            hist = hist.astype(jnp.float32) * _qscale_vec(gh_scale)
         return leaf_out[0, :n], hist.transpose(2, 0, 1, 3)
     if hilo:
         hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo

@@ -81,6 +81,14 @@ class WaveGrowerConfig(NamedTuple):
     # the fused kernel unpacks in VMEM, halving HBM residency. The
     # non-fused fallback unpacks once up front.
     packed4: bool = False
+    # quantized histogram reduction (int8 + data-parallel only,
+    # config.tpu_quantized_psum): the hist_reduce_fn collective sees
+    # the RAW int32 quantized histogram and dequantization happens
+    # AFTER the psum — exact integer addition on the wire (LightGBM's
+    # communication-compression analog). Sound because the
+    # quantization scales are GLOBAL (max_reduce_fn = pmax), so the
+    # scale factors commute with the cross-shard sum.
+    quant_psum: bool = False
 
 
 class _State(NamedTuple):
@@ -121,6 +129,28 @@ def _pallas_on(use_pallas: bool | None) -> bool:
     return use_pallas
 
 
+def _mix32(x: jax.Array) -> jax.Array:
+    """lowbias32 integer finalizer (uint32 -> well-mixed uint32) — the
+    stochastic-rounding hash. Wrapping uint32 arithmetic everywhere."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def _hash_uniform(idx: jax.Array, salt: jax.Array) -> jax.Array:
+    """Per-row uniform draws in [0, 1) keyed by GLOBAL row index +
+    salt. Position-deterministic: the draw of row i is the same no
+    matter how rows are sharded across devices, so quantized training
+    gives identical trees on 1 chip and on a row-sharded mesh (a
+    positional PRNG stream like jax.random.uniform(key, (n,)) would
+    not — its counter layout depends on the local shard length)."""
+    return (_mix32(idx ^ salt) >> jnp.uint32(8)).astype(
+        jnp.float32) * jnp.float32(2.0 ** -24)
+
+
 def _store_batch(table, idx, vals, active):
     """Masked scatter of per-slot values into a table.
 
@@ -136,7 +166,7 @@ def _store_batch(table, idx, vals, active):
 def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                      hist_fn=None, split_fn=None, partition_fn=None,
                      reduce_fn=None, hist_reduce_fn=None,
-                     max_reduce_fn=None, jit=True):
+                     max_reduce_fn=None, row_offset_fn=None, jit=True):
     """Build ``grow(bins_t, grad, hess, sample_mask, feature_mask)``.
 
     bins_t is FEATURE-MAJOR [F, N] (see ops/hist_wave.py).
@@ -155,7 +185,13 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         the fused partition+histogram kernel: each shard partitions and
         histograms its own rows in one Pallas pass and only the [W, F,
         B, 3] result rides the collective — the multi-chip path keeps
-        the single-chip kernel.
+        the single-chip kernel. With ``cfg.quant_psum`` the seam sees
+        the RAW int32 quantized histogram (dequantization runs after
+        the collective).
+      row_offset_fn(n_local) -> this shard's first GLOBAL row index
+        (data/voting: axis_index * n_local; default 0). Feeds the
+        stochastic-rounding hash so the quantization draw of a row is
+        identical no matter how rows are sharded.
 
     All default to serial single-device implementations. ``jit=False``
     returns the raw traceable fn for wrapping in shard_map.
@@ -191,6 +227,15 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 "int8 quantized histograms need a hist_fn that "
                 "accepts gh_scale (see the EFB bundle seam, "
                 "models/gbdt.py)")
+    defer = bool(cfg.quant_psum)
+    if defer and not quant:
+        raise ValueError("quant_psum requires precision='int8' "
+                         "(tpu_quantized_hist)")
+    if defer and (hist_fn is not None or partition_fn is not None):
+        # an injected seam returns DEQUANTIZED f32 histograms; psumming
+        # those as if they were the int32 wire would double-scale
+        raise ValueError("quant_psum does not compose with injected "
+                         "histogram/partition seams")
     use_fused = cfg.fused
     if use_fused is None:
         from .hist_wave import (FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO,
@@ -213,7 +258,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                   num_bins=B, chunk=cfg.chunk,
                                   use_pallas=cfg.use_pallas,
                                   precision=cfg.precision,
-                                  gh_scale=gh_scale)
+                                  gh_scale=gh_scale,
+                                  dequant=not defer)
 
     if split_fn is None:
         def split_fn(hists, sg, sh, nd, fmask, can):
@@ -240,6 +286,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     if max_reduce_fn is None:
         def max_reduce_fn(x):
             return x
+
+    if row_offset_fn is None:
+        def row_offset_fn(n_local):
+            return jnp.int32(0)
 
     def depth_ok(depth):
         if cfg.max_depth > 0:
@@ -290,24 +340,46 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         if quant:
             # gradient quantization (tpu_quantized_hist): integer-valued
             # g/h in [-127, 127] make every MXU histogram product an
-            # exact int8 op at 2x the bf16 rate. Stochastic rounding
-            # keeps the per-bin sums unbiased; the PRNG key is derived
-            # from the gradients themselves so each tree re-rolls.
-            kbits = jax.lax.bitcast_convert_type(
-                jnp.sum(grad).astype(f32), jnp.int32)
-            qkey = jax.random.fold_in(jax.random.PRNGKey(1729), kbits)
+            # exact int8 op at 2x the bf16 rate.
             # GLOBAL quantization scales (max_reduce_fn = pmax in data
             # mode): shard-local scales would make the dequantized psum
             # sums correct but leave count-proxy bounds computed on the
             # GLOBAL histogram invalid (divided by a local scale) and
-            # shard-divergent — every shard must see one (sg, sh)
+            # shard-divergent — every shard must see one (sg, sh).
+            # max is order-independent, so the pmax of shard maxima
+            # equals the single-chip max EXACTLY.
             sg_s = jnp.maximum(max_reduce_fn(jnp.max(jnp.abs(grad))),
                                1e-30) / 127.0
             sh_s = jnp.maximum(max_reduce_fn(jnp.max(hess)),
                                1e-30) / 127.0
-            u = jax.random.uniform(qkey, (2, n), dtype=f32)
-            gq = jnp.clip(jnp.floor(grad / sg_s + u[0]), -127.0, 127.0)
-            hq = jnp.clip(jnp.floor(hess / sh_s + u[1]), 0.0, 127.0)
+            # stochastic rounding keyed by GLOBAL row index (shard
+            # offset + local position) and a per-tree salt: unbiased
+            # per-bin sums and — unlike a positional PRNG stream —
+            # the same draw for the same row under ANY row sharding,
+            # so quantized data-parallel training reproduces the
+            # single-chip quantized trees. The salt mixes the scale
+            # bits with a WRAPPING int32 sum of the raw gradient bits:
+            # mod-2^32 adds commute, so the psum of shard-local bit
+            # sums equals the single-chip sum exactly (layout
+            # invariance), and the stream re-rolls whenever ANY row's
+            # gradient moves — scale bits alone would freeze it for
+            # constant-bound objectives (L1-family: max|g| and max h
+            # never change between trees).
+            bg = jax.lax.bitcast_convert_type(
+                sg_s.astype(f32), jnp.uint32)
+            bh = jax.lax.bitcast_convert_type(
+                sh_s.astype(f32), jnp.uint32)
+            gbits_sum = reduce_fn(jnp.sum(
+                jax.lax.bitcast_convert_type(grad, jnp.int32),
+                dtype=jnp.int32))
+            salt = (bg ^ ((bh << jnp.uint32(16)) | (bh >> jnp.uint32(16)))
+                    ^ _mix32(gbits_sum.astype(jnp.uint32)))
+            gidx = (row_offset_fn(n)
+                    + jnp.arange(n, dtype=jnp.int32)).astype(jnp.uint32)
+            u_g = _hash_uniform(gidx, salt)
+            u_h = _hash_uniform(gidx, salt ^ jnp.uint32(0x9E3779B9))
+            gq = jnp.clip(jnp.floor(grad / sg_s + u_g), -127.0, 127.0)
+            hq = jnp.clip(jnp.floor(hess / sh_s + u_h), 0.0, 127.0)
             gh_scale = (sg_s, sh_s)
             hg, hh = gq, hq            # what histogram passes consume
 
@@ -319,6 +391,20 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
 
             def call_hist(bt, lids, wl):
                 return hist_fn(bt, hg, hh, lids, wl)
+
+        def dq(hsum):
+            """Dequantize a reduced quantized-wire histogram — identity
+            unless cfg.quant_psum deferred the scaling past the
+            collective. Handles both the 2-channel proxy wire and the
+            3-channel wire (the XLA oracle keeps 3 channels)."""
+            if not defer:
+                return hsum
+            hsum = hsum.astype(f32)
+            sgf = jnp.float32(gh_scale[0])
+            shf = jnp.float32(gh_scale[1])
+            if hsum.shape[-1] == 2:
+                return hsum * jnp.stack([sgf, shf])
+            return hsum * jnp.stack([sgf, shf, jnp.float32(1.0)])
 
         # Bagging: leaf_ids tracks ALL rows (out-of-bag rows partition
         # too — scores need their leaf), but histogram passes see
@@ -341,11 +427,12 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 interpret=fused_interpret, precision=cfg.precision,
                 gh_scale=gh_scale, count_proxy=True,
                 packed4=cfg.packed4,
-                num_features=F if cfg.packed4 else None)
+                num_features=F if cfg.packed4 else None,
+                dequant=not defer)
         else:
             local_root = call_hist(bins_t, bag_mask_ids(leaf0),
                                    root_wl)              # [W, F, B, 3]
-        root_hist = hist_reduce_fn(local_root)
+        root_hist = dq(hist_reduce_fn(local_root))
         F_h = root_hist.shape[1]
         if quant:
             # root aggregates as dequantized sums of the SAME integer
@@ -488,9 +575,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     precision=cfg.precision, gh_scale=gh_scale,
                     any_cat=bool(hp.has_cat), count_proxy=proxy,
                     packed4=cfg.packed4,
-                    num_features=F if cfg.packed4 else None)
+                    num_features=F if cfg.packed4 else None,
+                    dequant=not defer)
                 leaf_ids, hist_small = fused_out[0], fused_out[1]
-                hist_small = hist_reduce_fn(hist_small)
+                hist_small = dq(hist_reduce_fn(hist_small))
                 if proxy:
                     cnt_r = reduce_fn(fused_out[2])
                 # out-of-bag rows partition too; their g/h are pre-masked
@@ -499,9 +587,9 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 leaf_ids = partition_fn(bins_t, state.leaf_ids, wl,
                                         new_ids, feat, tbin, dleft,
                                         active, iscat, catw)
-                hist_small = hist_reduce_fn(
+                hist_small = dq(hist_reduce_fn(
                     call_hist(bins_t, bag_mask_ids(leaf_ids),
-                              small_ids))
+                              small_ids)))
                 if proxy:
                     # exact in-bag right-child counts (XLA fallback for
                     # the Pallas kernel's partition-mask counting)
@@ -638,8 +726,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                     iscat0, catw0)
             # left child keeps the parent id: histogram it directly,
             # sibling by subtraction (sizes don't matter here)
-            hist_left = hist_reduce_fn(
-                call_hist(bins_t, bag_mask_ids(leaf_ids), wl))
+            hist_left = dq(hist_reduce_fn(
+                call_hist(bins_t, bag_mask_ids(leaf_ids), wl)))
             parent_hist = state.hist[wl]
             hist_right = parent_hist - hist_left
             wl_s = jnp.where(active, wl, L)

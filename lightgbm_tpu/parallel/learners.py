@@ -94,11 +94,37 @@ def _psum_seam(x):
     return ov(x, base) if ov is not None else base(x)
 
 
+_meshes_logged: set = set()
+
+
 def make_mesh(num_devices: Optional[int] = None) -> Mesh:
+    from ..utils import log
     from ..utils.device import get_devices
     devs = get_devices()
     n = len(devs) if num_devices is None else min(num_devices, len(devs))
+    kind = str(getattr(devs[0], "device_kind", None) or devs[0].platform)
+    # one info line per distinct mesh per process (ingest + grower +
+    # every CV fold all build the same mesh; size-1 meshes are about
+    # to be discarded with a serial-fallback warning)
+    emit = log.info if n > 1 and (n, kind) not in _meshes_logged \
+        else log.debug
+    _meshes_logged.add((n, kind))
+    emit("mesh built: %d device(s) of kind %s on axis %r", n, kind, AXIS)
     return Mesh(np.asarray(devs[:n]), (AXIS,))
+
+
+def training_mesh(config) -> Optional[Mesh]:
+    """The >1-device mesh the configured tree learner trains over, or
+    None (serial learner, or only one device available). ONE policy
+    for every consumer — sharded ingest (io/ingest.py) must assemble
+    bins under exactly the mesh the grower will shard_map over, or
+    init pays the full-matrix reshard this path exists to avoid."""
+    if getattr(config, "tree_learner", "serial") == "serial":
+        return None
+    want = (config.num_machines
+            if getattr(config, "num_machines", 1) > 1 else None)
+    mesh = make_mesh(want)
+    return mesh if mesh.devices.size > 1 else None
 
 
 def sync_best_splits(res: SplitResult) -> SplitResult:
@@ -157,13 +183,21 @@ def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         # shard-divergent (and same-seed parity with serial improves)
         return jax.lax.pmax(x, AXIS)
 
+    def row_offset_fn(n_local):
+        # global row index base: shard d holds the contiguous rows
+        # [d*n_local, (d+1)*n_local) of the padded global matrix, so
+        # the stochastic-rounding hash draws the SAME uniform for the
+        # same row as the single-chip grower (serial quantized parity)
+        return jax.lax.axis_index(AXIS) * jnp.int32(n_local)
+
     # hist_fn (e.g. the EFB bundle-expansion seam) composes: each shard
     # histograms its own rows through it, then the expanded [W, F, B, 3]
     # rides the psum exactly like the default seam's output
     grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
                             hist_reduce_fn=reduce_fn,
                             reduce_fn=reduce_fn,
-                            max_reduce_fn=max_reduce_fn, jit=False)
+                            max_reduce_fn=max_reduce_fn,
+                            row_offset_fn=row_offset_fn, jit=False)
     sharded = _shard_map(
         grow, mesh=mesh,
         in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P(None)),
@@ -349,9 +383,16 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     axis=1)[:, 0],
                 -1))
 
+    def row_offset_fn(n_local):
+        # shard-invariant stochastic-rounding stream (see the
+        # data-parallel learner)
+        return jax.lax.axis_index(AXIS) * jnp.int32(n_local)
+
     grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
                             split_fn=split_fn,
-                            reduce_fn=reduce_fn, jit=False)
+                            reduce_fn=reduce_fn,
+                            max_reduce_fn=lambda x: jax.lax.pmax(x, AXIS),
+                            row_offset_fn=row_offset_fn, jit=False)
     sharded = _shard_map(
         grow, mesh=mesh,
         in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P(None)),
