@@ -72,6 +72,12 @@ def main():
                          "ICI — the multi-chip path for the v5e-8 "
                          "north-star target (falls back to serial on "
                          "one device)")
+    ap.add_argument("--retrain", type=int, default=0, metavar="K",
+                    help="after the timed run, train K fresh boosters "
+                         "back-to-back on the same data (the lrb.py "
+                         "sliding-window pattern) and report warm vs "
+                         "cold compile time + step-cache hit rate in "
+                         "the JSON output")
     ap.add_argument("--run-report", default="",
                     help="write the run-report artifact here "
                          "(tpu_run_report; .jsonl for line-delimited). "
@@ -223,6 +229,43 @@ def main():
     # None (JSON null) when accounting is unavailable (serial/voting):
     # a literal 0 would read as "zero cross-chip bytes"
     comm_per_iter = round(float(np.mean(comm))) if comm else None
+
+    # --retrain K: the lrb.py per-window pattern — K FRESH boosters on
+    # the same data. With the compiled-step registry warm from the run
+    # above, each retrain's first step should dispatch in ~0s (a cache
+    # hit) instead of re-paying the cold compile.
+    from lightgbm_tpu.ops import step_cache
+    retrain = None
+    if args.retrain > 0:
+        warm_first = []
+        s0 = step_cache.stats()
+        t_retrain = time.time()
+        for r in range(args.retrain):
+            gr_ = GBDT()
+            gr_.init(cfg, ds, obj, mets)
+            t0 = time.time()
+            gr_.train_one_iter()
+            sync_r = float(_np.asarray(gr_._scores[0, :1])[0])  # noqa: F841
+            warm_first.append(time.time() - t0)
+            for _ in range(4):
+                gr_.train_one_iter()
+            float(_np.asarray(gr_._scores[0, :1])[0])
+        s1 = step_cache.stats()
+        hits, misses = s1["hits"] - s0["hits"], s1["misses"] - s0["misses"]
+        retrain = {
+            "boosters": args.retrain,
+            "cold_compile_s": round(compile_s, 3),
+            "warm_first_step_s": round(float(np.mean(warm_first)), 3),
+            "hits": hits, "misses": misses,
+            "hit_rate": round(hits / max(hits + misses, 1), 3),
+            "total_s": round(time.time() - t_retrain, 2),
+        }
+        print(f"# retrain x{args.retrain}: warm first-step "
+              f"{retrain['warm_first_step_s']:.3f}s vs cold compile "
+              f"{compile_s:.1f}s, step-cache hit rate "
+              f"{retrain['hit_rate']:.0%}", file=sys.stderr)
+
+    recorder.meta["step_cache"] = step_cache.stats()
     report = recorder.finish(
         leaves_per_iteration=leaves or None,
         waves_per_iteration=waves or None,
@@ -240,6 +283,8 @@ def main():
         "ingest": "host" if args.no_ingest else "auto",
         "chips": g.num_devices,
         "comm_bytes_per_iter": comm_per_iter,
+        "step_cache": step_cache.stats(),
+        "retrain": retrain,
         "metric": ("HIGGS-class GBDT training throughput "
                    f"({args.rows} rows x 28 feat, {args.leaves} leaves, "
                    f"{args.max_bin} bins, {args.iters} iters, "

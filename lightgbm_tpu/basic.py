@@ -605,7 +605,7 @@ class Booster:
             fn = g._device_eval_fn(di, metrics)
             if fn is None:
                 return None
-            scores = (g._scores if di == 0
+            scores = (g.train_scores() if di == 0
                       else g._valid_scores[di - 1])
             arr = fn(scores)
             try:
@@ -629,7 +629,7 @@ class Booster:
     def __inner_predict(self, data_idx: int) -> np.ndarray:
         """Raw scores for train (0) or valid set (1..); flattened
         class-major for multiclass like the reference."""
-        scores = (self._gbdt._scores if data_idx == 0
+        scores = (self._gbdt.train_scores() if data_idx == 0
                   else self._gbdt._valid_scores[data_idx - 1])
         raw = np.asarray(scores, np.float64)
         return raw[0] if raw.shape[0] == 1 else raw.reshape(-1)

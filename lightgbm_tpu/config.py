@@ -363,6 +363,36 @@ class Config:
     # rows per ingest pipeline chunk; 0 = auto (a power of two sized so
     # one chunk carries ~64 MB of raw values).
     tpu_ingest_chunk_rows: int = 0
+    # process-wide compiled-step registry (ops/step_cache.py): the fused
+    # training step becomes a pure function of an explicit geometry key
+    # and the jitted callable is shared across boosters — a per-window
+    # retrain loop (lrb.py) or a test suite compiles each distinct
+    # geometry ONCE instead of once per booster. A registry hit is
+    # bit-exact by construction (the key covers everything that shapes
+    # the trace; data flows through traced arguments). -1 = auto (on);
+    # 0 = off (per-booster closures, the pre-cache behavior); 1 = on.
+    tpu_step_cache: int = -1
+    # shape bucketing for the shared step (ops/step_cache.py): rows pad
+    # up to this policy's width with a validity mask zeroing the pad
+    # rows, the histogram bin axis pads to the next power of two and
+    # the feature axis to a multiple of 8 (trivial-column exclusion and
+    # observed bin counts make BOTH data-dependent), so boosters whose
+    # data shapes land in the same buckets share ONE compiled step.
+    # -1 = auto (rows: next power of two, min 256); 0 = exact shapes
+    # everywhere (shared only between identically-shaped boosters);
+    # N > 0 = rows round up to a multiple of N. Pad rows carry exact
+    # +0.0 grad/hess and a zero bagging mask, pad bins/features are
+    # masked per-feature via the traced metadata — histograms, root
+    # aggregates, the stochastic-rounding stream and renew percentiles
+    # are bit-identical to the exact-shape run.
+    tpu_row_bucket: int = -1
+    # persistent XLA compile cache on NON-TPU backends (ops/autotune.py
+    # ensure_compile_cache): the cache is always wired on TPU, but this
+    # image's jax 0.4.x CPU backend flakily segfaults while
+    # DESERIALIZING warm entries (~1/3 of warm runs), so CPU defaults to
+    # recompiling. 1 = opt in on jax >= 0.5 (where the deserializer is
+    # fixed); ignored with a warning on older jax. 0 = off (default).
+    tpu_compile_cache_cpu: int = 0
 
     def __post_init__(self):
         self._raw_params: Dict[str, str] = {}
@@ -526,6 +556,18 @@ class Config:
             log.warning("tpu_autotune=%r is not one of on/off/exhaustive;"
                         " using 'on'", self.tpu_autotune)
             self.tpu_autotune = "on"
+        if self.tpu_step_cache not in (-1, 0, 1):
+            log.warning("tpu_step_cache=%d is not one of -1/0/1; using "
+                        "-1 (auto)", self.tpu_step_cache)
+            self.tpu_step_cache = -1
+        if self.tpu_row_bucket < -1:
+            log.warning("tpu_row_bucket=%d is negative; using -1 "
+                        "(power-of-two buckets)", self.tpu_row_bucket)
+            self.tpu_row_bucket = -1
+        if self.tpu_compile_cache_cpu not in (0, 1):
+            log.warning("tpu_compile_cache_cpu=%d is not 0/1; using 0 "
+                        "(off)", self.tpu_compile_cache_cpu)
+            self.tpu_compile_cache_cpu = 0
         if self.is_provide_training_metric or self.valid:
             if not self.metric:
                 # force defaults from objective later; handled by metric factory

@@ -174,6 +174,11 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                         booster.record_comm_bytes(recorder, waves)
             except Exception:       # noqa: BLE001 — telemetry must
                 pass                # never fail the training result
+            try:
+                from .ops import step_cache
+                recorder.meta["step_cache"] = step_cache.stats()
+            except Exception:       # noqa: BLE001
+                pass
             recorder.finish(
                 leaves_per_iteration=leaves or None,
                 waves_per_iteration=waves or None,

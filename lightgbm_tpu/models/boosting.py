@@ -42,6 +42,11 @@ def create_boosting(boosting_type: str) -> GBDT:
 class GOSS(GBDT):
     """Gradient-based One-Side Sampling (goss.hpp:26-216)."""
 
+    # no shared-step reuse: the in-jit sampler draws a positional PRNG
+    # stream (jax.random.uniform over the row axis) whose values depend
+    # on the padded width — bucket-padded GOSS would not be bit-exact
+    _step_cache_ok = False
+
     def init(self, config, train_data, objective, training_metrics=()):
         super().init(config, train_data, objective, training_metrics)
         self._reset_goss()
@@ -179,7 +184,7 @@ class DART(GBDT):
             for k in range(K):
                 rec = self.records[i * K + k]
                 leaf = replay_partition(rec, tb,
-                                        self._meta)[:self._n]
+                                        self._meta)[:self._n_score]
                 self._scores = self._scores.at[k].set(add_leaf_outputs(
                     self._scores[k], leaf, rec.leaf_output, -1.0))
         kdrop = len(self._drop_index)
@@ -220,7 +225,7 @@ class DART(GBDT):
                             self._valid_scores[vi][k], vleaf, old_out,
                             keep_scale - 1.0))
                 # train: was subtracted fully, add back keep_scale*old
-                leaf = replay_partition(rec, tb, self._meta)[:self._n]
+                leaf = replay_partition(rec, tb, self._meta)[:self._n_score]
                 self._scores = self._scores.at[k].set(add_leaf_outputs(
                     self._scores[k], leaf, old_out, keep_scale))
                 self.records[t] = rec._replace(
@@ -235,6 +240,10 @@ class DART(GBDT):
 class RF(GBDT):
     """Random Forest (rf.hpp:18-172): bagged trees on fixed targets,
     averaged predictions."""
+
+    # no shared-step reuse: RF replaces the base fused step with the
+    # running-mean averaging step below (its own _get_step_fn)
+    _step_cache_ok = False
 
     def __init__(self):
         super().__init__()

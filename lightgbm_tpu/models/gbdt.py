@@ -51,6 +51,12 @@ K_MODEL_VERSION = "v2"     # gbdt.h kModelVersion
 class GBDT:
     """Gradient Boosting Decision Tree driver (boosting.h:22 interface)."""
 
+    # class-level gate for the compiled-step registry: variants whose
+    # step is not a pure function of the shared geometry opt out —
+    # GOSS's in-jit sampler draws a positional PRNG stream whose values
+    # depend on the padded width, RF replaces the step entirely
+    _step_cache_ok = True
+
     def __init__(self):
         self.config: Optional[Config] = None
         self.train_data: Optional[TpuDataset] = None
@@ -91,10 +97,14 @@ class GBDT:
         # kernel autotuner + persistent XLA compile cache: tile choices
         # come from the on-disk tuning cache (timed once per shape) and
         # repeated runs skip recompilation entirely (ops/autotune.py)
-        from ..ops import autotune
+        from ..ops import autotune, step_cache
         autotune.configure(config.tpu_autotune,
                            config.tpu_tuning_cache or None)
-        autotune.ensure_compile_cache()
+        autotune.ensure_compile_cache(
+            cpu_opt_in=config.tpu_compile_cache_cpu == 1)
+        # process-wide compiled-step registry (ops/step_cache.py):
+        # eligible boosters share ONE jitted training step per geometry
+        step_cache.configure(config.tpu_step_cache, config.tpu_row_bucket)
         self.objective = objective
         self.training_metrics = list(training_metrics)
         self.iter_ = 0
@@ -109,6 +119,11 @@ class GBDT:
         n = train_data.num_data
         self._n = n
         self._meta = train_data.feature_meta()
+        # fresh init: score buffers are rebuilt below, so _setup_grower
+        # must not freeze shape decisions to a previous dataset's
+        # (reset_parameter, which keeps the buffers, re-enters with
+        # _scores live and DOES freeze them)
+        self._scores = None
         self._setup_grower()
         # feature-major device layout [F, N] (ops/hist_wave.py); EFB
         # bundles share columns (io/efb.py)
@@ -212,6 +227,13 @@ class GBDT:
         self._dummy_gh = jnp.zeros((1, 1), jnp.float32)
         self._dummy_key = jax.random.PRNGKey(0)
         self._fmask_cache = None
+        # shared-step arguments (ops/step_cache.py): the row-validity
+        # mask distinguishing real rows from bucket-pad rows, and the
+        # per-booster aux pytree built lazily on first step build
+        rv = np.zeros(self._n_score, bool)
+        rv[:self._n] = True
+        self._rvalid_dev = self._place_step_rows(rv)
+        self._step_dispatched = False
 
     def _setup_grower(self):
         cfg = self.config
@@ -258,7 +280,12 @@ class GBDT:
         f = max(self.train_data.num_features, 1)
         self._pad_rows = 0
         self._pad_features = 0
-        meta = self._meta
+        # fresh per-feature metadata each entry: reset_parameter
+        # re-enters this method, and re-padding an already-padded
+        # self._meta would corrupt the pad (it also picks up
+        # monotone/penalty changes from the new config)
+        meta = self.train_data.feature_meta()
+        self._meta = meta
 
         # wave size: leaves split per device step (ops/wave_grower.py);
         # 0 = auto. Capped by the Pallas channel budget AND kept a
@@ -322,6 +349,34 @@ class GBDT:
         # this (kernel, features, bins, tier, device) shape times a
         # small VMEM-feasible candidate set and persists the winner;
         # off-TPU the measured per-tier default is used untouched.
+        # compiled-step registry eligibility decides shape policy from
+        # here on: eligible boosters pad the histogram bin axis to a
+        # power-of-two bucket (step_cache.bucket_bins) so boosters whose
+        # OBSERVED max bin counts differ — every sliding window of the
+        # lrb.py workload — still share one compiled step. Padded
+        # columns are inert: no bin value reaches them and the split
+        # finder masks per-feature via the traced meta.num_bin.
+        from ..ops import step_cache
+        prev_elig = getattr(self, "_cache_eligible", None)
+        self._cache_eligible = self._step_cache_eligible(mode)
+        if (prev_elig is not None
+                and getattr(self, "_scores", None) is not None):
+            # mid-life reset_parameter cannot switch step
+            # implementations: the score/bins widths are frozen to the
+            # live device buffers below, and the legacy closure cannot
+            # consume a bucketed width (nor the shared step an exact
+            # one) — a flipped knob only affects future boosters
+            self._cache_eligible = prev_elig
+        B_hist = max(self.train_data.max_bin_global, 2)
+        if self._cache_eligible:
+            B_hist = step_cache.bucket_bins(B_hist, cfg.tpu_row_bucket)
+            # the FEATURE axis is data-dependent too (the dataset
+            # excludes trivial columns, so a 53-column window sample
+            # can surface 51 features and the next 52): bucket F to a
+            # multiple of 8 with trivial pad features — num_bin=1
+            # yields zero split candidates and the fmask pads False,
+            # exactly the feature-parallel mode's proven pad scheme
+            self._pad_features = (-f) % 8
         if cfg.tpu_hist_chunk > 0:
             kchunk = cfg.tpu_hist_chunk
         else:
@@ -334,9 +389,8 @@ class GBDT:
                 # default-seams rule: serial/data without bundles
                 fused=not bundled and mode in ("serial", "data"),
                 F=(len(td.bundles) if bundled
-                   else max(td.num_features, 1)),
-                B=(max(td.bundle_width, 2) if bundled
-                   else max(td.max_bin_global, 2)),
+                   else max(td.num_features, 1) + self._pad_features),
+                B=(max(td.bundle_width, 2) if bundled else B_hist),
                 W=W, precision=precision, count_proxy=proxy,
                 packed4=packed4, any_cat=bool(hp.has_cat),
                 bins_bytes=(1 if (host_bins.dtype == np.uint8
@@ -371,26 +425,75 @@ class GBDT:
             from ..utils.device import on_tpu
             if on_tpu():
                 self._pad_rows = (-self._n) % kchunk
+        # alignment unit the row padding above respects — the bucketed
+        # score width must stay a multiple of it (even shards for the
+        # data/voting learners, chunk-aligned rows for the TPU kernels)
+        if mode in ("data", "voting"):
+            unit = D * kchunk if self._n >= 4 * D * kchunk else D
+        elif mode == "serial":
+            from ..utils.device import on_tpu
+            unit = kchunk if on_tpu() else 1
+        else:
+            unit = 1
+        self._row_align_unit = unit
+        # compiled-step registry (ops/step_cache.py): eligible boosters
+        # bucket the score-block width so boosters whose row counts
+        # land in the same bucket share ONE compiled step; the bins
+        # matrix widens to at least that width. Ineligible
+        # configurations keep exact shapes (n_score == n), as does the
+        # f32 data-parallel learner: bucketing moves the row->shard
+        # boundaries, which regroups the f32 histogram/root psums and
+        # drifts the last bit — the quantized path's integer wire is
+        # grouping-invariant, so it buckets freely. Exact-shape cached
+        # boosters still share steps between same-N runs.
+        prev_ns = getattr(self, "_n_score", None)
+        self._n_score = self._n
+        if self._cache_eligible and (mode == "serial" or quant):
+            ns = step_cache.bucket_rows(self._n, unit,
+                                        cfg.tpu_row_bucket)
+            local = ns // (D if mode in ("data", "voting") else 1)
+            if quant and 127 * local >= 2 ** 31:
+                # bucket pad would push the padded shard past the int8
+                # kernels' int32 histogram-sum bound: keep exact shapes
+                # (the registry still shares between same-N boosters)
+                ns = self._n
+            self._n_score = max(ns, self._n)
+        if prev_ns is not None and getattr(self, "_scores",
+                                           None) is not None:
+            # reset_parameter re-entry: the score/rvalid widths were
+            # allocated at init and are frozen — a changed bucket
+            # decision must not orphan the live buffers
+            self._n_score = prev_ns
+        self._pad_rows = max(self._pad_rows,
+                             self._n_score - self._n)
         if mode == "feature" and not self._use_bundles:
             self._pad_features = (-f) % D
-            if self._pad_features:
-                pad = self._pad_features
-                meta = type(meta)(
-                    num_bin=np.concatenate(
-                        [meta.num_bin, np.ones(pad, np.int32)]),
-                    missing_type=np.concatenate(
-                        [meta.missing_type, np.zeros(pad, np.int32)]),
-                    default_bin=np.concatenate(
-                        [meta.default_bin, np.zeros(pad, np.int32)]),
-                    monotone=np.concatenate(
-                        [meta.monotone, np.zeros(pad, np.int32)]),
-                    penalty=np.concatenate(
-                        [meta.penalty, np.ones(pad, np.float32)]),
-                    is_cat=np.concatenate(
-                        [np.broadcast_to(np.asarray(meta.is_cat,
-                                                    np.int32), (f,)),
-                         np.zeros(pad, np.int32)]))
-                self._meta = meta
+        if (prev_ns is not None
+                and getattr(self, "_scores", None) is not None
+                and getattr(self, "_f_pad", None) is not None):
+            # re-entry: the [F_pad, N] bins matrix is device-resident
+            # at the width chosen at init — a changed pad decision
+            # (e.g. reset_parameter flipping a step-cache knob) must
+            # not orphan it
+            self._pad_features = self._f_pad - f
+        if self._pad_features:
+            pad = self._pad_features
+            meta = type(meta)(
+                num_bin=np.concatenate(
+                    [meta.num_bin, np.ones(pad, np.int32)]),
+                missing_type=np.concatenate(
+                    [meta.missing_type, np.zeros(pad, np.int32)]),
+                default_bin=np.concatenate(
+                    [meta.default_bin, np.zeros(pad, np.int32)]),
+                monotone=np.concatenate(
+                    [meta.monotone, np.zeros(pad, np.int32)]),
+                penalty=np.concatenate(
+                    [meta.penalty, np.ones(pad, np.float32)]),
+                is_cat=np.concatenate(
+                    [np.broadcast_to(np.asarray(meta.is_cat,
+                                                np.int32), (f,)),
+                     np.zeros(pad, np.int32)]))
+            self._meta = meta
         self._n_pad = self._n + self._pad_rows
         self._f_pad = f + self._pad_features
 
@@ -407,8 +510,10 @@ class GBDT:
                 and not self._use_bundles):
             from ..ops.autotune import tune_hist_psum
             quant_psum = tune_hist_psum(
-                mesh=mesh, W=W, F=f,
-                B=max(self.train_data.max_bin_global, 2),
+                # the PADDED axes: that is the [W, F, B, C] block the
+                # psum actually carries (F pads to /8 when eligible)
+                mesh=mesh, W=W, F=self._f_pad,
+                B=B_hist,
                 channels=2 if proxy else 3,
                 n_rows_global=self._n_pad,
                 requested=cfg.tpu_quantized_psum)
@@ -421,7 +526,7 @@ class GBDT:
             num_leaves=max(cfg.num_leaves, 2),
             # >= 2 so the per-feature split scan is never empty (the
             # all-trivial-features case has one dummy single-bin feature)
-            num_bins=max(self.train_data.max_bin_global, 2),
+            num_bins=B_hist,
             wave_size=W,
             max_depth=cfg.max_depth,
             # autotuned row chunk (ops/autotune.py; defaults: 16384
@@ -470,6 +575,32 @@ class GBDT:
             mode, gcfg, meta, mesh, self._f_pad, cfg.top_k,
             hist_fn=hist_fn, efb_feature=efb_feature)
         self._step_key = None       # grower changed: rebuild fused step
+
+    def _step_cache_eligible(self, mode: str) -> bool:
+        """True when this booster's fused step can be served by the
+        process-wide registry (ops/step_cache.py): serial/data learner
+        without EFB bundles, an objective with a pure gradient seam
+        (or none — custom gradients are traced arguments anyway), and
+        a boosting variant whose step is the standard one. Reads THIS
+        booster's config knob, not the module global — another
+        booster's init must not flip a live booster's shape policy."""
+        if self.config.tpu_step_cache == 0 or not type(self)._step_cache_ok:
+            return False
+        if self._use_bundles or mode not in ("serial", "data"):
+            return False
+        if mode == "data":
+            # externally-injected collectives (LGBM_NetworkInitWith-
+            # Functions) are arbitrary callables the geometry key
+            # cannot cover — a cached step would silently bypass the
+            # injected wrapper (or serve a program traced with a
+            # different one); trace per-instance instead
+            from ..parallel.learners import _collective_overrides
+            if _collective_overrides:
+                return False
+        obj = self.objective
+        if obj is not None and obj.gradient_builder() is None:
+            return False
+        return True
 
     # -- sharded iteration state (data/voting over a mesh) -------------------
 
@@ -533,6 +664,17 @@ class GBDT:
             return jnp.asarray(x)
         return jax.device_put(x, self._named_sharding(None, "rows"))
 
+    def _place_step_rows(self, x):
+        """Row-aligned shared-step argument ([..., n_score]: rvalid,
+        padded objective aux): sharded on the row axis when the
+        iteration state is, so the jitted step never reshards it."""
+        x = np.asarray(x)
+        if (not self._row_sharded()
+                or x.shape[-1] % self.num_devices):
+            return jnp.asarray(x)
+        spec = ("rows",) if x.ndim == 1 else (None, "rows")
+        return jax.device_put(x, self._named_sharding(*spec))
+
     def _parse_forced_splits(self) -> tuple:
         """forcedsplits_filename JSON -> BFS-ordered
         ((parent_leaf, inner_feature, bin), ...) matching the
@@ -586,11 +728,17 @@ class GBDT:
 
     def _init_scores(self):
         n, k = self._n, self.num_tree_per_iteration
-        init = np.zeros((k, n), np.float32)
+        ns = self._n_score
+        # score block at the (possibly bucketed) width: columns past n
+        # are pad rows whose gradients the step forces to exact +0.0
+        # (step_cache.build_train_step rvalid mask) — their score
+        # values are never read by metrics or predictions
+        init = np.zeros((k, ns), np.float32)
         self._boost_from_avg_done = [False] * k
         md = self.train_data.metadata
         if md.init_score is not None:
-            init += np.asarray(md.init_score, np.float32).reshape(k, n)
+            init[:, :n] += np.asarray(md.init_score,
+                                      np.float32).reshape(k, n)
         self._scores = self._place_scores(init)
         self._valid_scores: List[jax.Array] = []
 
@@ -665,7 +813,8 @@ class GBDT:
             cls = t_idx % self.num_tree_per_iteration
             leaf = replay_partition(rec, self._train_bins_unpacked(), self._meta)
             self._scores = self._scores.at[cls].set(add_leaf_outputs(
-                self._scores[cls], leaf[:self._n], rec.leaf_output, 1.0))
+                self._scores[cls], leaf[:self._n_score],
+                rec.leaf_output, 1.0))
         self.iter_ = len(loaded_models) // self.num_tree_per_iteration
         self._clean_groups = self.iter_
         log.info("Continuing training from iteration %d", self.iter_)
@@ -831,6 +980,103 @@ class GBDT:
             return init
         return 0.0
 
+    # -- shared fused step (ops/step_cache.py) -------------------------------
+
+    def _pad_step_aux(self, aux):
+        """Host aux pytree -> device: every array leaf's LAST axis is
+        the row axis (objectives/objective.py seam contract); pad it
+        from n to the bucketed n_score with zeros and place it under
+        the step's row sharding."""
+        if aux is None:
+            return None
+        if isinstance(aux, dict):
+            return {k: self._pad_step_aux(v) for k, v in aux.items()}
+        a = np.asarray(aux)
+        pad = self._n_score - a.shape[-1]
+        if pad:
+            a = np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
+        return self._place_step_rows(a)
+
+    def _step_geometry_key(self, custom: bool, obj, renew_alpha,
+                           aux_dev, meta_dev) -> tuple:
+        """Hashable registry key covering EVERYTHING that shapes the
+        step's trace — a hit is guaranteed to be a functionally
+        identical program (data flows through traced arguments)."""
+        from ..ops import step_cache
+        mesh_key = (None if self._mesh is None else
+                    tuple(int(d.id) for d in self._mesh.devices.flat))
+        bins = self._bins_dev
+        return (
+            "train_step",
+            self.num_tree_per_iteration, self._n_score, self._n_total,
+            tuple(self._valid_row_slices),
+            self._learner_mode, mesh_key,
+            bool(self._row_sharded()
+                 and self._n_score % self.num_devices == 0),
+            self._grower_cfg, self._f_pad,
+            (bins.shape[0], str(bins.dtype)),
+            ("custom",) if custom or obj is None else obj.static_key(),
+            renew_alpha,
+            step_cache.aux_signature(aux_dev),
+            step_cache.aux_signature(
+                dict(zip(type(meta_dev)._fields, meta_dev))),
+        )
+
+    def _get_cached_step(self, custom: bool):
+        """Fetch (or build once per geometry, process-wide) the shared
+        fused step and bind this booster's rvalid/meta/aux arguments."""
+        from ..ops import step_cache
+        key_local = ("cache", custom, len(self._valid_bins_dev))
+        if getattr(self, "_step_key", None) == key_local:
+            return self._step_fn
+        obj = self.objective
+        grad_fn = (None if custom or obj is None
+                   else obj.gradient_builder())
+        renew = grad_fn is not None and obj.is_renew_tree_output()
+        renew_alpha = (float(obj.renew_tree_output_percentile())
+                       if renew else None)
+        aux_host = {"obj": None, "renew": None}
+        if grad_fn is not None:
+            aux_host["obj"] = obj.gradient_aux()
+        if renew:
+            lbl = (obj.trans_label if hasattr(obj, "trans_label")
+                   else obj.label)
+            w = getattr(obj, "label_weight", None)
+            if w is None:
+                w = obj.weights
+            aux_host["renew"] = {
+                "label": np.asarray(lbl, np.float32),
+                "w": None if w is None else np.asarray(w, np.float32)}
+        aux_dev = self._pad_step_aux(aux_host)
+        meta = self._meta
+        meta_dev = type(meta)(*[jnp.asarray(x) for x in meta])
+        key = self._step_geometry_key(custom, obj, renew_alpha,
+                                      aux_dev, meta_dev)
+        grower = self._grower
+        K = self.num_tree_per_iteration
+
+        def builder():
+            return step_cache.build_train_step(
+                grower=grower, K=K, n_score=self._n_score,
+                n_total=self._n_total,
+                valid_slices=tuple(self._valid_row_slices),
+                num_leaves=self._grower_cfg.num_leaves,
+                grad_fn=grad_fn, renew_alpha=renew_alpha,
+                sample_hook=None)
+
+        shared = step_cache.get_step(key, builder)
+        rvalid = self._rvalid_dev
+
+        def stepfn(bins, scores, valid_scores, mask, fmask, shrink,
+                   init_bias, g_in, h_in, prng):
+            return shared(bins, scores, valid_scores, mask, fmask,
+                          shrink, init_bias, g_in, h_in, prng,
+                          rvalid, meta_dev, aux_dev)
+
+        self._step_fn = stepfn
+        self._step_key = key_local
+        return stepfn
+
     def _get_step_fn(self, custom: bool):
         """ONE jitted function for a full boosting iteration.
 
@@ -840,9 +1086,17 @@ class GBDT:
         eager op dispatch is a high-latency host<->device RPC on this
         platform (measured ~24 ms per op on the tunneled backend), and
         an un-fused iteration pays ~100 of them. Fused: one dispatch.
-        Retraces only when a valid set is added or the custom-gradient
-        mode flips; shrinkage/init-bias are traced arguments.
+
+        Eligible configurations route to the PROCESS-WIDE registry
+        (ops/step_cache.py via _get_cached_step): the step is a pure
+        function of a geometry key and is compiled once per geometry,
+        not once per booster. Ineligible ones keep this per-instance
+        closure. Retraces only when a valid set is added or the
+        custom-gradient mode flips; shrinkage/init-bias are traced
+        arguments.
         """
+        if getattr(self, "_cache_eligible", False):
+            return self._get_cached_step(custom)
         key = (custom, len(self._valid_bins_dev))
         if getattr(self, "_step_key", None) == key:
             return self._step_fn
@@ -968,6 +1222,12 @@ class GBDT:
         else:
             g_in = jnp.asarray(grad, jnp.float32).reshape(K, self._n)
             h_in = jnp.asarray(hess, jnp.float32).reshape(K, self._n)
+            pad = self._n_score - self._n
+            if pad:
+                # bucketed step width: pad custom gradients with exact
+                # zeros (the rvalid mask re-zeroes them in-step anyway)
+                g_in = jnp.pad(g_in, ((0, 0), (0, pad)))
+                h_in = jnp.pad(h_in, ((0, 0), (0, pad)))
 
         mask_np = self._bagging_mask(self.iter_)
         if mask_np is None:
@@ -988,12 +1248,26 @@ class GBDT:
             key = jax.random.PRNGKey(self._hook_rng.integers(1, 2**31))
         else:
             key = self._dummy_key
+        first_dispatch = not getattr(self, "_step_dispatched", True)
+        if first_dispatch:
+            import time as _time
+            t0 = _time.monotonic()
         with timing.phase("train/step_dispatch"):
             self._scores, new_valids, recs = step(
                 self._bins_dev,
                 self._scores, tuple(self._valid_scores), mask, fmask,
                 jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in,
                 key)
+        if first_dispatch:
+            # per-booster compile span: the first dispatch pays
+            # trace+compile on a registry miss and ~nothing on a hit —
+            # the spread of this timer across boosters IS the
+            # amortization the step cache buys (run reports pick the
+            # registry totals up via meta.step_cache)
+            self._step_dispatched = True
+            from ..obs import registry as obs
+            obs.timer("step_cache/first_step_s").add(
+                _time.monotonic() - t0)
         self._valid_scores = list(new_valids)
         for k, rec in enumerate(recs):
             shrinkage_for_file = self.shrinkage_rate
@@ -1088,7 +1362,7 @@ class GBDT:
                 self.models.pop()
                 self._tree_shrinkage.pop()
                 leaf = replay_partition(rec, self._train_bins_unpacked(),
-                                        self._meta)[:self._n]
+                                        self._meta)[:self._n_score]
                 self._scores = self._scores.at[k].set(add_leaf_outputs(
                     self._scores[k], leaf, rec.leaf_output, -1.0))
                 for vi in range(len(self.valid_sets)):
@@ -1216,7 +1490,7 @@ class GBDT:
         full [K, N] score tensor."""
         out = []
         if data_idx == 0:
-            scores = self._scores
+            scores = self.train_scores()
             metrics = self.training_metrics
         else:
             scores = self._valid_scores[data_idx - 1]
@@ -1232,6 +1506,14 @@ class GBDT:
                 for name, val in m.eval(raw, self.objective):
                     out.append((name, val, m.bigger_is_better))
         return out
+
+    def train_scores(self) -> jax.Array:
+        """[K, n] train scores with any bucket-pad columns sliced off —
+        every consumer outside the fused step (metrics, fobj, inner
+        predict) must read scores through this, not ``_scores``."""
+        if self._n_score != self._n:
+            return self._scores[:, :self._n]
+        return self._scores
 
     def _device_eval_fn(self, data_idx: int, metrics):
         """Jitted scores -> stacked metric scalars, cached per dataset;
@@ -1424,21 +1706,25 @@ class GBDT:
 
         self._init_scores()
         n_iters = len(self.records) // K
+        n = self._n
         for it in range(n_iters):
+            # gradients see the REAL rows only (objective arrays are
+            # [n]; bucket-pad score columns are sliced off)
+            sc = self.train_scores()
             g_all, h_all = self.objective.get_gradients(
-                self._scores if K > 1 else self._scores[0])
+                sc if K > 1 else sc[0])
             if K == 1:
                 g_all, h_all = g_all[None, :], h_all[None, :]
             for k in range(K):
                 t = it * K + k
                 rec = self.records[t]
                 leaf = replay_partition(rec, self._train_bins_unpacked(),
-                                        self._meta)[:self._n]
+                                        self._meta)[:n]
                 new_scores, out = refit_one(
-                    self._scores[k], rec.leaf_output, leaf,
+                    self._scores[k, :n], rec.leaf_output, leaf,
                     g_all[k], h_all[k],
                     jnp.float32(self._tree_shrinkage[t]))
-                self._scores = self._scores.at[k].set(new_scores)
+                self._scores = self._scores.at[k, :n].set(new_scores)
                 self.records[t] = rec._replace(leaf_output=out)
                 self.models[t] = None
         self._bump_model_gen()
@@ -1629,6 +1915,14 @@ class GBDT:
                 # cross-chip traffic: every root/wave histogram pass
                 # moves one [W, F, B, C] block through the psum
                 self.record_comm_bytes(recorder, waves)
+            from ..ops import step_cache
+            # registry totals are process-wide; booster_eligible is
+            # THIS booster's routing (the global "enabled" is
+            # last-init-wins and may describe a different booster)
+            recorder.meta["step_cache"] = dict(
+                step_cache.stats(),
+                booster_eligible=bool(getattr(self, "_cache_eligible",
+                                              False)))
             recorder.finish(
                 leaves_per_iteration=leaves, waves_per_iteration=waves,
                 extra={"trained_iterations": self.iter_,
@@ -1639,6 +1933,8 @@ class GBDT:
             # the normal path above already finished with leaf counts)
             profile.close()
             self._recorder = None
+            from ..ops import step_cache
+            recorder.meta.setdefault("step_cache", step_cache.stats())
             recorder.finish(extra={"aborted": True})
         timing.log_report("training phase timings "
                           "(serial_tree_learner.cpp:14-41 analog)")
@@ -1685,7 +1981,7 @@ class GBDT:
             fn = self._device_eval_fn(idx, metrics)
             if fn is None:
                 return None
-            scores = (self._scores if idx == 0
+            scores = (self.train_scores() if idx == 0
                       else self._valid_scores[idx - 1])
             out[idx] = (metrics, fn(scores))
         return out

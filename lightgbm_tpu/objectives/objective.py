@@ -50,6 +50,34 @@ class ObjectiveFunction:
     def get_gradients(self, score):
         raise NotImplementedError
 
+    # -- pure gradient seam (ops/step_cache.py) ---------------------------
+    #
+    # The process-wide compiled-step registry shares ONE jitted training
+    # step between boosters, so the gradient computation cannot close
+    # over this instance's label/weight arrays (they would embed as
+    # trace constants). Eligible objectives expose:
+    #   gradient_aux()      -> pytree of host arrays whose LAST axis is
+    #                          the row axis (the caller pads it to the
+    #                          step's bucketed width)
+    #   gradient_builder()  -> pure fn(score, aux) -> (g, h) closing
+    #                          only over config scalars
+    #   static_key()        -> hashable tuple of everything the builder
+    #                          closes over (part of the geometry key)
+    # ``get_gradients`` delegates to the same pure fn, so the legacy
+    # per-instance step and the shared step run IDENTICAL code — a
+    # registry hit cannot change numerics. Objectives without a sound
+    # pure seam (lambdarank's query-padded aux) return None and keep
+    # the legacy closure.
+
+    def gradient_aux(self):
+        return None
+
+    def gradient_builder(self):
+        return None
+
+    def static_key(self) -> tuple:
+        return (self.name,)
+
     def boost_from_score(self, class_id: int) -> float:
         return 0.0
 
@@ -88,12 +116,21 @@ class RegressionL2Loss(ObjectiveFunction):
             self.trans_label = self.label
         self.is_constant_hessian = self.weights is None
 
+    def gradient_aux(self):
+        return {"y": self.trans_label, "w": self.weights}
+
+    def gradient_builder(self):
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            g = _wmul(score - y, w)
+            h = jnp.ones_like(score) if w is None else w
+            return g, h
+        return fn
+
     def get_gradients(self, score):
-        y = jnp.asarray(self.trans_label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        g = _wmul(score - y, w)
-        h = jnp.ones_like(score) if w is None else w
-        return g, h
+        return self.gradient_builder()(score, self.gradient_aux())
 
     def boost_from_score(self, class_id):
         # weighted mean label (regression_objective.hpp:142-160)
@@ -113,13 +150,15 @@ class RegressionL1Loss(RegressionL2Loss):
     leaf outputs renewed to the residual median (hpp:219-258)."""
     name = "regression_l1"
 
-    def get_gradients(self, score):
-        y = jnp.asarray(self.trans_label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        diff = score - y
-        g = _wmul(jnp.sign(diff), w)
-        h = jnp.ones_like(score) if w is None else w
-        return g, h
+    def gradient_builder(self):
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            g = _wmul(jnp.sign(score - y), w)
+            h = jnp.ones_like(score) if w is None else w
+            return g, h
+        return fn
 
     def boost_from_score(self, class_id):
         # weighted median (hpp:204-217)
@@ -137,15 +176,22 @@ class RegressionHuberLoss(RegressionL2Loss):
     name = "huber"
     is_constant_hessian = False
 
-    def get_gradients(self, score):
-        y = jnp.asarray(self.trans_label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        a = self.config.alpha
-        diff = score - y
-        g = jnp.where(jnp.abs(diff) <= a, diff, jnp.sign(diff) * a)
-        g = _wmul(g, w)
-        h = jnp.ones_like(score) if w is None else w
-        return g, h
+    def gradient_builder(self):
+        a = float(self.config.alpha)
+
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            diff = score - y
+            g = jnp.where(jnp.abs(diff) <= a, diff, jnp.sign(diff) * a)
+            g = _wmul(g, w)
+            h = jnp.ones_like(score) if w is None else w
+            return g, h
+        return fn
+
+    def static_key(self):
+        return (self.name, float(self.config.alpha))
 
 
 class RegressionFairLoss(RegressionL2Loss):
@@ -153,17 +199,21 @@ class RegressionFairLoss(RegressionL2Loss):
     name = "fair"
     is_constant_hessian = False
 
-    def get_gradients(self, score):
-        y = jnp.asarray(self.trans_label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        c = self.config.fair_c
-        x = score - y
-        g = _wmul(c * x / (jnp.abs(x) + c), w)
-        h = _wmul(c * c / (jnp.abs(x) + c) ** 2,
-                  w if w is not None else None)
-        if w is None:
-            h = c * c / (jnp.abs(x) + c) ** 2
-        return g, h
+    def gradient_builder(self):
+        c = float(self.config.fair_c)
+
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            x = score - y
+            g = _wmul(c * x / (jnp.abs(x) + c), w)
+            h = _wmul(c * c / (jnp.abs(x) + c) ** 2, w)
+            return g, h
+        return fn
+
+    def static_key(self):
+        return (self.name, float(self.config.fair_c))
 
 
 class RegressionPoissonLoss(RegressionL2Loss):
@@ -176,14 +226,23 @@ class RegressionPoissonLoss(RegressionL2Loss):
         if np.any(self.label < 0):
             log.fatal("[poisson]: at least one target label is negative")
 
-    def get_gradients(self, score):
-        y = jnp.asarray(self.label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        g = _wmul(jnp.exp(score) - y, w)
-        h = _wmul(jnp.exp(score + self.config.poisson_max_delta_step),
-                  w) if w is not None else \
-            jnp.exp(score + self.config.poisson_max_delta_step)
-        return g, h
+    def gradient_aux(self):
+        return {"y": self.label, "w": self.weights}
+
+    def gradient_builder(self):
+        mds = float(self.config.poisson_max_delta_step)
+
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            g = _wmul(jnp.exp(score) - y, w)
+            h = _wmul(jnp.exp(score + mds), w)
+            return g, h
+        return fn
+
+    def static_key(self):
+        return (self.name, float(self.config.poisson_max_delta_step))
 
     def boost_from_score(self, class_id):
         return math.log(max(RegressionL2Loss.boost_from_score(self, class_id),
@@ -197,14 +256,23 @@ class RegressionQuantileLoss(RegressionL2Loss):
     """Quantile (regression_objective.hpp:465-487)."""
     name = "quantile"
 
-    def get_gradients(self, score):
-        y = jnp.asarray(self.label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        a = self.config.alpha
-        g = jnp.where(score > y, 1.0 - a, -a)
-        g = _wmul(g, w)
-        h = jnp.ones_like(score) if w is None else w
-        return g, h
+    def gradient_aux(self):
+        return {"y": self.label, "w": self.weights}
+
+    def gradient_builder(self):
+        a = float(self.config.alpha)
+
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            g = _wmul(jnp.where(score > y, 1.0 - a, -a), w)
+            h = jnp.ones_like(score) if w is None else w
+            return g, h
+        return fn
+
+    def static_key(self):
+        return (self.name, float(self.config.alpha))
 
     def boost_from_score(self, class_id):
         return _weighted_percentile(self.label, self.weights,
@@ -227,14 +295,19 @@ class RegressionMAPELoss(RegressionL2Loss):
         if self.weights is not None:
             self.label_weight = self.label_weight * self.weights
 
-    def get_gradients(self, score):
-        y = jnp.asarray(self.label)
-        lw = jnp.asarray(self.label_weight)
-        diff = score - y
-        g = jnp.sign(diff) * lw
-        h = (jnp.ones_like(score) if self.weights is None
-             else jnp.asarray(self.weights))
-        return g, h
+    def gradient_aux(self):
+        return {"y": self.label, "lw": self.label_weight,
+                "w": self.weights}
+
+    def gradient_builder(self):
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            lw = jnp.asarray(aux["lw"])
+            g = jnp.sign(score - y) * lw
+            h = (jnp.ones_like(score) if aux["w"] is None
+                 else jnp.asarray(aux["w"]))
+            return g, h
+        return fn
 
     def boost_from_score(self, class_id):
         return _weighted_percentile(self.label, self.label_weight, 0.5)
@@ -250,27 +323,41 @@ class RegressionGammaLoss(RegressionPoissonLoss):
     """Gamma (regression_objective.hpp:663-675)."""
     name = "gamma"
 
-    def get_gradients(self, score):
-        y = jnp.asarray(self.label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        g = 1.0 - y / jnp.exp(score)
-        h = y / jnp.exp(score)
-        return _wmul(g, w), _wmul(h, w) if w is not None else h
+    def gradient_builder(self):
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            g = 1.0 - y / jnp.exp(score)
+            h = y / jnp.exp(score)
+            return _wmul(g, w), _wmul(h, w)
+        return fn
+
+    def static_key(self):
+        return (self.name,)
 
 
 class RegressionTweedieLoss(RegressionPoissonLoss):
     """Tweedie (regression_objective.hpp:701-722)."""
     name = "tweedie"
 
-    def get_gradients(self, score):
-        y = jnp.asarray(self.label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        rho = self.config.tweedie_variance_power
-        e1 = jnp.exp((1.0 - rho) * score)
-        e2 = jnp.exp((2.0 - rho) * score)
-        g = -y * e1 + e2
-        h = -y * (1.0 - rho) * e1 + (2.0 - rho) * e2
-        return _wmul(g, w), _wmul(h, w) if w is not None else h
+    def gradient_builder(self):
+        rho = float(self.config.tweedie_variance_power)
+
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            e1 = jnp.exp((1.0 - rho) * score)
+            e2 = jnp.exp((2.0 - rho) * score)
+            g = -y * e1 + e2
+            h = -y * (1.0 - rho) * e1 + (2.0 - rho) * e2
+            return _wmul(g, w), _wmul(h, w)
+        return fn
+
+    def static_key(self):
+        return (self.name,
+                float(self.config.tweedie_variance_power))
 
 
 # --------------------------------------------------------------------------
@@ -304,16 +391,30 @@ class BinaryLogloss(ObjectiveFunction):
         if cnt_pos == 0 or cnt_neg == 0:
             log.warning("Contains only one class")
 
+    def gradient_aux(self):
+        return {"lv": self.label_val, "lw": self.label_weight,
+                "w": self.weights}
+
+    def gradient_builder(self):
+        sig = float(self.sigmoid)
+
+        def fn(score, aux):
+            lv = jnp.asarray(aux["lv"])
+            lw = jnp.asarray(aux["lw"])
+            if aux["w"] is not None:
+                lw = lw * jnp.asarray(aux["w"])
+            response = -lv * sig / (1.0 + jnp.exp(lv * sig * score))
+            ar = jnp.abs(response)
+            g = response * lw
+            h = ar * (sig - ar) * lw
+            return g, h
+        return fn
+
+    def static_key(self):
+        return (self.name, float(self.sigmoid))
+
     def get_gradients(self, score):
-        lv = jnp.asarray(self.label_val)
-        lw = jnp.asarray(self.label_weight)
-        if self.weights is not None:
-            lw = lw * jnp.asarray(self.weights)
-        response = -lv * self.sigmoid / (1.0 + jnp.exp(lv * self.sigmoid * score))
-        ar = jnp.abs(response)
-        g = response * lw
-        h = ar * (self.sigmoid - ar) * lw
-        return g, h
+        return self.gradient_builder()(score, self.gradient_aux())
 
     def boost_from_score(self, class_id):
         # binary_objective.hpp:124-142
@@ -356,17 +457,31 @@ class MulticlassSoftmax(ObjectiveFunction):
     def num_model_per_iteration(self):
         return self.num_class
 
+    def gradient_aux(self):
+        return {"yi": self.label_int, "w": self.weights}
+
+    def gradient_builder(self):
+        K = int(self.num_class)
+
+        def fn(score, aux):
+            """score: [K, N] -> grads/hess [K, N]
+            (multiclass_objective.hpp:68)."""
+            y = jax.nn.one_hot(jnp.asarray(aux["yi"]), K, axis=0,
+                               dtype=score.dtype)   # [K, N]
+            p = jax.nn.softmax(score, axis=0)
+            g = p - y
+            h = 2.0 * p * (1.0 - p)
+            if aux["w"] is not None:
+                w = jnp.asarray(aux["w"])[None, :]
+                g, h = g * w, h * w
+            return g, h
+        return fn
+
+    def static_key(self):
+        return (self.name, int(self.num_class))
+
     def get_gradients(self, score):
-        """score: [K, N] -> grads/hess [K, N] (multiclass_objective.hpp:68)."""
-        y = jax.nn.one_hot(jnp.asarray(self.label_int), self.num_class,
-                           axis=0, dtype=score.dtype)   # [K, N]
-        p = jax.nn.softmax(score, axis=0)
-        g = p - y
-        h = 2.0 * p * (1.0 - p)
-        if self.weights is not None:
-            w = jnp.asarray(self.weights)[None, :]
-            g, h = g * w, h * w
-        return g, h
+        return self.gradient_builder()(score, self.gradient_aux())
 
     def boost_from_score(self, class_id):
         return 0.0
@@ -402,13 +517,32 @@ class MulticlassOVA(ObjectiveFunction):
     def num_model_per_iteration(self):
         return self.num_class
 
+    def gradient_aux(self):
+        return {"lv": np.stack([b.label_val for b in self.binary]),
+                "lw": np.stack([b.label_weight for b in self.binary]),
+                "w": self.weights}
+
+    def gradient_builder(self):
+        # K independent binary objectives, vectorized over the class
+        # axis — elementwise, so bit-identical to the per-class loop
+        sig = float(self.config.sigmoid)
+
+        def fn(score, aux):
+            lv = jnp.asarray(aux["lv"])             # [K, N]
+            lw = jnp.asarray(aux["lw"])
+            if aux["w"] is not None:
+                lw = lw * jnp.asarray(aux["w"])[None, :]
+            response = -lv * sig / (1.0 + jnp.exp(lv * sig * score))
+            ar = jnp.abs(response)
+            return response * lw, ar * (sig - ar) * lw
+        return fn
+
+    def static_key(self):
+        return (self.name, int(self.num_class),
+                float(self.config.sigmoid))
+
     def get_gradients(self, score):
-        gs, hs = [], []
-        for k in range(self.num_class):
-            g, h = self.binary[k].get_gradients(score[k])
-            gs.append(g)
-            hs.append(h)
-        return jnp.stack(gs), jnp.stack(hs)
+        return self.gradient_builder()(score, self.gradient_aux())
 
     def boost_from_score(self, class_id):
         return self.binary[class_id].boost_from_score(0)
@@ -440,15 +574,24 @@ class CrossEntropy(ObjectiveFunction):
     """xentropy (hpp:77-86): labels in [0,1]; z = sigmoid(s)."""
     name = "cross_entropy"
 
+    def gradient_aux(self):
+        return {"y": self.label, "w": self.weights}
+
+    def gradient_builder(self):
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            w = aux["w"]
+            w = None if w is None else jnp.asarray(w)
+            z = 1.0 / (1.0 + jnp.exp(-score))
+            g = _wmul(z - y, w)
+            h = z * (1.0 - z)
+            if w is not None:
+                h = h * w
+            return g, h
+        return fn
+
     def get_gradients(self, score):
-        y = jnp.asarray(self.label)
-        w = None if self.weights is None else jnp.asarray(self.weights)
-        z = 1.0 / (1.0 + jnp.exp(-score))
-        g = _wmul(z - y, w)
-        h = z * (1.0 - z)
-        if w is not None:
-            h = h * w
-        return g, h
+        return self.gradient_builder()(score, self.gradient_aux())
 
     def boost_from_score(self, class_id):
         # xentropy_objective.hpp:107-118: log(pavg / (1 - pavg))
@@ -471,26 +614,24 @@ class CrossEntropyLambda(ObjectiveFunction):
     """xentlambda (hpp:150-240): intensity-weighted cross entropy."""
     name = "cross_entropy_lambda"
 
+    def gradient_aux(self):
+        return {"y": self.label, "w": self.weights}
+
+    def gradient_builder(self):
+        weighted = self.weights is not None
+
+        def fn(score, aux):
+            y = jnp.asarray(aux["y"])
+            if not weighted:
+                # unit weights: identical to CrossEntropy (hpp:184-189)
+                z = 1.0 / (1.0 + jnp.exp(-score))
+                return z - y, z * (1.0 - z)
+            return _xentlambda_weighted(score, y,
+                                        jnp.asarray(aux["w"]))
+        return fn
+
     def get_gradients(self, score):
-        y = jnp.asarray(self.label)
-        if self.weights is None:
-            # unit weights: identical to CrossEntropy (hpp:184-189)
-            z = 1.0 / (1.0 + jnp.exp(-score))
-            return z - y, z * (1.0 - z)
-        # weighted case (xentropy_objective.hpp:192-206)
-        w = jnp.asarray(self.weights)
-        epf = jnp.exp(score)
-        hhat = jnp.log1p(epf)
-        z = 1.0 - jnp.exp(-w * hhat)
-        enf = 1.0 / epf
-        g = (1.0 - y / z) * w / (1.0 + enf)
-        c = 1.0 / (1.0 - z)
-        d = 1.0 + epf
-        a = w * epf / (d * d)
-        d = c - 1.0
-        b = (c / (d * d)) * (1.0 + w * epf - c)
-        h = a * (1.0 + y * b)
-        return g, h
+        return self.gradient_builder()(score, self.gradient_aux())
 
     def boost_from_score(self, class_id):
         pavg = float(np.mean(self.label))
@@ -502,6 +643,22 @@ class CrossEntropyLambda(ObjectiveFunction):
 
     def to_string(self):
         return "cross_entropy_lambda"
+
+
+def _xentlambda_weighted(score, y, w):
+    """Weighted xentlambda grads (xentropy_objective.hpp:192-206)."""
+    epf = jnp.exp(score)
+    hhat = jnp.log1p(epf)
+    z = 1.0 - jnp.exp(-w * hhat)
+    enf = 1.0 / epf
+    g = (1.0 - y / z) * w / (1.0 + enf)
+    c = 1.0 / (1.0 - z)
+    d = 1.0 + epf
+    a = w * epf / (d * d)
+    d = c - 1.0
+    b = (c / (d * d)) * (1.0 + w * epf - c)
+    h = a * (1.0 + y * b)
+    return g, h
 
 
 # --------------------------------------------------------------------------

@@ -383,7 +383,16 @@ def device_kind() -> str:
 _compile_cache_done = False
 
 
-def ensure_compile_cache(path: Optional[str] = None) -> None:
+def _jax_version() -> tuple:
+    import jax
+    try:
+        return tuple(int(x) for x in jax.__version__.split(".")[:2])
+    except (AttributeError, ValueError):
+        return (0, 0)
+
+
+def ensure_compile_cache(path: Optional[str] = None,
+                         cpu_opt_in: bool = False) -> None:
     """Wire jax's persistent compilation cache so the grower/predict
     kernels compile once per machine, not once per process (~tens of
     seconds per distinct shape on TPU). Idempotent; an explicit
@@ -393,19 +402,34 @@ def ensure_compile_cache(path: Optional[str] = None) -> None:
     Mosaic compiles live, and this image's jax 0.4.x CPU backend
     flakily segfaults while DESERIALIZING warm cache entries (observed
     ~1/3 of warm-cache test runs) — a CPU process recompiles instead.
-    An operator who wants the cache on CPU sets
-    jax_compilation_cache_dir explicitly (it is respected)."""
+    ``cpu_opt_in`` (config.tpu_compile_cache_cpu) enables the cache on
+    non-TPU backends, gated on jax >= 0.5 where the CPU
+    cache-deserialization path is fixed — on older jax it warns and
+    stays off (the original segfault note above). An operator can
+    always set jax_compilation_cache_dir explicitly (it is respected
+    on any jax)."""
     global _compile_cache_done
     if _compile_cache_done:
         return
-    _compile_cache_done = True
     import jax
     try:
+        _compile_cache_done = True
         if getattr(jax.config, "jax_compilation_cache_dir", None):
             return                       # operator already configured it
         from ..utils.device import on_tpu
         if not on_tpu():
-            return
+            if not cpu_opt_in:
+                # NOT a terminal decision: a later booster may opt in
+                # (tpu_compile_cache_cpu=1), so leave the flag unset
+                _compile_cache_done = False
+                return
+            if _jax_version() < (0, 5):
+                log.warning(
+                    "tpu_compile_cache_cpu=1 needs jax >= 0.5 (this "
+                    "jax %s flakily segfaults deserializing warm CPU "
+                    "cache entries); leaving the persistent compile "
+                    "cache off", jax.__version__)
+                return
         from ..io.dataset import default_cache_dir
         jax.config.update("jax_compilation_cache_dir",
                           path or os.path.join(default_cache_dir(), "xla"))

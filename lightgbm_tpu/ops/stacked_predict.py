@@ -480,8 +480,12 @@ class StackedModel:
             return acc.T.astype(np.float64)
         dev = self._device_arrays(first, ntree)
         # pad rows to a power-of-two bucket so repeated odd-sized calls
-        # reuse one compiled kernel instead of recompiling per shape
-        bucket = min(row_chunk, max(256, 1 << (N - 1).bit_length()))
+        # reuse one compiled kernel instead of recompiling per shape —
+        # same policy (and tpu_row_bucket knob) as the training step's
+        # registry; these chunk kernels are module-level jits, so the
+        # bucketed shape is shared across StackedModel instances too
+        from .step_cache import bucket_rows
+        bucket = min(row_chunk, bucket_rows(N))
         pad = (-N) % bucket
         if pad:
             rows = np.concatenate([rows, np.zeros(

@@ -1,0 +1,316 @@
+"""Process-wide compiled-step registry: cross-booster reuse of the
+fused training step.
+
+The paper's core workload (lrb.py) trains a FRESH booster per sliding
+window, and before this module every ``GBDT`` instance re-traced and
+re-compiled its fused iteration step from scratch — the tier-1 suite
+was compile-bound and BENCH_r05 paid 18.8 s of compile+iter0 against
+112 s of training. The fix is the standard JAX serving/training
+pattern: make the step a pure function of an explicit, hashable
+**geometry key** and cache the resulting ``jax.jit`` callable
+process-wide.
+
+What had to move out of the per-instance closures to get there:
+
+- **Feature metadata** (per-feature bin counts / missing types / ...):
+  traced argument threaded through the grower (ops/wave_grower.py
+  ``grow(..., meta=...)``) instead of factory-time constants — two
+  boosters binned on different data share one trace.
+- **Objective data** (labels, weights, renew targets): the objectives
+  expose a pure ``gradient_builder()`` closing only over config
+  scalars; the row-aligned arrays ride an ``aux`` pytree argument
+  (objectives/objective.py).
+- **The row count**: rows pad up to a power-of-two bucket
+  (``tpu_row_bucket``) with a validity-mask argument zeroing the pad
+  rows' gradients — boosters with different N share one compiled step
+  bit-exactly (the pad rows carry exact +0.0 g/h and a zero bagging
+  mask, so histograms, root aggregates, the integer salt of the
+  stochastic-rounding stream, and renew percentiles are untouched).
+- **The bin and feature axes**: the histogram width is the max
+  OBSERVED bin count and trivial columns are excluded from F, so both
+  drift with the data; B pads to the next power of two
+  (``bucket_bins``) and F to a multiple of 8 with trivial pad
+  features — every sliding window of the paper workload shares one
+  geometry instead of recompiling per window.
+
+The registry key covers everything that shapes the trace (learner
+mode, mesh device ids, WaveGrowerConfig incl. split hyperparameters
+and forced splits, valid-set slice layout, bins dtype/shape, objective
+static key, aux structure, renew spec), so a hit is guaranteed to be a
+functionally identical program. Ineligible configurations (EFB
+bundles, feature/voting learners, RF's averaging step, GOSS — its
+in-jit sampler draws a positional PRNG stream whose values depend on
+the padded width, so bucket-padded GOSS would not be bit-exact —
+and objectives without a pure gradient seam) simply keep the legacy
+per-instance closure — correctness first, reuse where it is sound.
+
+Counters land in the obs registry (``step_cache/hits|misses|
+evictions``, ``step_cache/compile`` timer with per-key first-dispatch
+wall time, ``step_cache/first_step_s`` per-booster spans recorded by
+gbdt) and ``stats()`` is snapshotted into run reports
+(``meta.step_cache``) and bench JSON.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+from ..obs import registry as obs
+from ..utils import log
+
+# bounded registry: one entry per distinct training geometry; an LRU
+# evict keeps pathological sweeps (e.g. a num_leaves grid search) from
+# pinning every compiled executable forever
+MAX_ENTRIES = 64
+
+# smallest pow2 bucket the auto policy pads to: tiny test datasets
+# share one step without ballooning (a 50-row set pads to 256 rows of
+# zero-mask work — noise)
+MIN_BUCKET = 256
+
+_lock = threading.Lock()
+_steps: "OrderedDict[tuple, Callable]" = OrderedDict()
+_mode = -1          # config.tpu_step_cache   (-1 auto / 0 off / 1 on)
+_bucket = -1        # config.tpu_row_bucket   (-1 pow2 / 0 exact / N)
+
+
+def configure(step_cache: int = -1, row_bucket: int = -1) -> None:
+    """Install the config knobs (called from GBDT.init)."""
+    global _mode, _bucket
+    _mode = int(step_cache)
+    _bucket = int(row_bucket)
+
+
+def enabled() -> bool:
+    """Cross-booster step reuse active? (-1 auto = on: the cache is a
+    pure win on every backend — compiled steps are only shared between
+    bit-identical programs.)"""
+    return _mode != 0
+
+
+def bucket_rows(n: int, align: int = 1, policy: Optional[int] = None) -> int:
+    """Padded row-block width for ``n`` data rows under the bucketing
+    policy, always a multiple of ``align`` (the learner's shard/chunk
+    alignment unit). ``policy`` is the calling booster's own
+    ``tpu_row_bucket`` — per-booster, so one booster's init cannot
+    change another live booster's shape policy through the module
+    globals (those remain only the default for config-less callers
+    like the stacked predictor).
+
+    -1 (auto): next power of two >= max(n, MIN_BUCKET) up to 16384;
+    above that, pow2/16 steps — a pure pow2 pad could cost a single
+    big-N booster up to 2x row work per iteration for a compile it
+    amortizes only once, so the pad is capped at ~1/8 (still a
+    log-bounded bucket count: 8 buckets per octave).
+    0: exact shapes (only the alignment pad, the pre-cache behavior).
+    N > 0: round up to a multiple of N. Note only tpu_row_bucket=0
+    disables shape padding; tpu_step_cache=0 switches the TRAINING
+    step back to per-booster closures but keeps predict-path
+    bucketing (the pre-registry behavior).
+    """
+    align = max(int(align), 1)
+    p = (_bucket if policy is None else int(policy))
+    if p == 0:
+        return _round_up(n, align)
+    if p > 0:
+        return _round_up(_round_up(n, p), align)
+    b = max(n, MIN_BUCKET)
+    if b <= (1 << 14):
+        b = 1 << (b - 1).bit_length()
+    else:
+        b = _round_up(b, 1 << ((b - 1).bit_length() - 4))
+    return _round_up(b, align)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def bucket_bins(b: int, policy: Optional[int] = None) -> int:
+    """Padded histogram bin-axis width for ``b`` actual global bins.
+
+    The grower's B dimension is the max OBSERVED per-feature bin count,
+    which drifts with the data (a 256-row window sample bins to 51
+    distinct values, the next to 46) — without padding, every sliding
+    window of the paper workload is a fresh geometry and the registry
+    never hits. Padding to the next power of two (floor 16, so the
+    4-bit packed tier's B<=16 bound is never crossed by padding alone)
+    is sound because the split finder masks per-feature via the TRACED
+    ``meta.num_bin`` (bins >= num_bin contribute zero and their
+    candidates are -inf), and histogram scatters never touch columns
+    no bin value reaches. tpu_row_bucket=0 (exact shapes) disables
+    this too — the knob means "no shape padding anywhere". ``policy``
+    is the calling booster's own tpu_row_bucket (see bucket_rows)."""
+    p = (_bucket if policy is None else int(policy))
+    if p == 0:
+        return b
+    return 1 << (max(b, 16) - 1).bit_length()
+
+
+def aux_signature(aux) -> tuple:
+    """Hashable structure+shape+dtype fingerprint of an aux pytree
+    (nested dicts of arrays / None) — part of the geometry key, so two
+    boosters only share a step when their traced aux trees match."""
+    if aux is None:
+        return ("none",)
+    if isinstance(aux, dict):
+        return tuple((k, aux_signature(aux[k])) for k in sorted(aux))
+    return (tuple(getattr(aux, "shape", ())),
+            str(getattr(aux, "dtype", type(aux).__name__)))
+
+
+def get_step(key: tuple, builder: Callable[[], Callable]) -> Callable:
+    """Registry lookup: return the process-wide compiled step for
+    ``key``, building (and instrumenting) it on first encounter."""
+    with _lock:
+        fn = _steps.get(key)
+        if fn is not None:
+            _steps.move_to_end(key)
+            obs.counter("step_cache/hits").add(1)
+            return fn
+    obs.counter("step_cache/misses").add(1)
+    fn = _instrument(builder())
+    with _lock:
+        # lost race: another thread built it first — keep theirs
+        # (functionally identical by key construction)
+        have = _steps.get(key)
+        if have is not None:
+            return have
+        while len(_steps) >= MAX_ENTRIES:
+            _steps.popitem(last=False)
+            obs.counter("step_cache/evictions").add(1)
+        _steps[key] = fn
+    return fn
+
+
+def _instrument(fn: Callable) -> Callable:
+    """Record the wall time of the first dispatch of a cached step —
+    jit compiles synchronously on first call while the result stays
+    async, so this span is trace+compile time to within dispatch
+    noise."""
+    state = {"first": True}
+
+    def call(*args):
+        if state["first"]:
+            state["first"] = False
+            t0 = time.monotonic()
+            out = fn(*args)
+            dt = time.monotonic() - t0
+            obs.timer("step_cache/compile").add(dt)
+            log.debug("step cache: compiled a new fused step in %.2fs",
+                      dt)
+            return out
+        return fn(*args)
+
+    return call
+
+
+def stats() -> Dict:
+    """Snapshot for run reports / bench JSON (meta.step_cache)."""
+    t = obs.timer("step_cache/compile")
+    with _lock:
+        entries = len(_steps)
+    return {
+        "enabled": enabled(),
+        "entries": entries,
+        "hits": obs.counter("step_cache/hits").value,
+        "misses": obs.counter("step_cache/misses").value,
+        "evictions": obs.counter("step_cache/evictions").value,
+        "compile_s": round(t.total, 3),
+        "compiles": t.count,
+    }
+
+
+def clear() -> None:
+    """Drop every cached step (tests; frees the jit executables)."""
+    with _lock:
+        _steps.clear()
+
+
+# ---------------------------------------------------------------------------
+# The shared fused-step builder
+# ---------------------------------------------------------------------------
+
+def build_train_step(*, grower, K: int, n_score: int, n_total: int,
+                     valid_slices: tuple, num_leaves: int,
+                     grad_fn: Optional[Callable],
+                     renew_alpha: Optional[float],
+                     sample_hook: Optional[Callable]) -> Callable:
+    """ONE jitted function for a full boosting iteration, pure in its
+    geometry: every data-dependent array (bins, scores, masks, labels
+    via ``aux``, feature metadata via ``meta``, the row-validity mask
+    ``rvalid``) is a traced argument, so the compiled program is
+    shared by every booster with the same geometry key.
+
+    Mirrors GBDT._get_step_fn's legacy closure step exactly (gradient
+    -> K tree builds -> renew -> shrinkage -> score updates -> AddBias
+    on the stored record); the only additions are the ``rvalid`` mask
+    (pad rows' g/h forced to exact +0.0, reproducing the legacy static
+    zero-pad bit-for-bit) and the explicit meta/aux arguments.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .predict import add_leaf_outputs
+
+    pad_tail = n_total - n_score
+    renew = renew_alpha is not None and grad_fn is not None
+    if renew:
+        from .renew import renew_leaf_outputs
+
+    def step(bins, scores, valid_scores, mask, fmask, shrink,
+             init_bias, g_in, h_in, key, rvalid, meta, aux):
+        if grad_fn is None:
+            g_all, h_all = g_in, h_in
+        else:
+            g_all, h_all = grad_fn(scores if K > 1 else scores[0],
+                                   aux["obj"])
+            if K == 1:
+                g_all, h_all = g_all[None, :], h_all[None, :]
+        # pad rows: exact +0.0 g/h (a multiply by the zero mask would
+        # produce -0.0 for negative gradients, perturbing the integer
+        # bit-sum salt of the quantized stochastic-rounding stream)
+        g_all = jnp.where(rvalid[None, :], g_all, 0.0)
+        h_all = jnp.where(rvalid[None, :], h_all, 0.0)
+        if sample_hook is not None:
+            g_all, h_all, mask = sample_hook(g_all, h_all, mask, key)
+        recs = []
+        vs = list(valid_scores)
+        for k in range(K):
+            g_k, h_k = g_all[k], h_all[k]
+            if pad_tail:
+                z = jnp.zeros(pad_tail, jnp.float32)
+                g_k = jnp.concatenate([g_k, z])
+                h_k = jnp.concatenate([h_k, z])
+            rec, leaf_full = grower(bins, g_k, h_k, mask, fmask, meta)
+            leaf_ids = leaf_full[:n_score]
+            if renew:
+                # objective-driven leaf refit against the PRE-update
+                # scores; pad rows carry zero weight through ``mask``
+                # and cannot shift the percentiles
+                residual = aux["renew"]["label"] - scores[k]
+                new_out = renew_leaf_outputs(
+                    leaf_ids, residual, aux["renew"].get("w"),
+                    num_leaves, renew_alpha, rec.leaf_output,
+                    mask[:n_score])
+                new_out = jnp.where(rec.num_leaves > 1, new_out,
+                                    rec.leaf_output)
+                rec = rec._replace(leaf_output=new_out)
+            rec = rec._replace(
+                leaf_output=rec.leaf_output * shrink,
+                internal_value=rec.internal_value * shrink)
+            scores = scores.at[k].set(add_leaf_outputs(
+                scores[k], leaf_ids, rec.leaf_output, 1.0))
+            for vi, (voff, vn) in enumerate(valid_slices):
+                vleaf = leaf_full[voff:voff + vn]
+                vs[vi] = vs[vi].at[k].set(add_leaf_outputs(
+                    vs[vi][k], vleaf, rec.leaf_output, 1.0))
+            rec = rec._replace(
+                leaf_output=rec.leaf_output + init_bias[k],
+                internal_value=rec.internal_value + init_bias[k])
+            recs.append(rec)
+        return scores, tuple(vs), recs
+
+    return jax.jit(step, donate_argnums=(1, 2))

@@ -129,6 +129,30 @@ def _pallas_on(use_pallas: bool | None) -> bool:
     return use_pallas
 
 
+_SUM_BLOCK = 8192
+
+
+def _stable_sum(v: jax.Array) -> jax.Array:
+    """Shape-stable f32 row reduction: fixed-width blocks reduced
+    per-block, then accumulated SEQUENTIALLY. A zero-padded tail (the
+    step cache's row bucketing, ops/step_cache.py) then cannot perturb
+    rounding — appended blocks are all-+0.0 and add exact zeros to the
+    running total, so bucket-padded training reproduces the exact-shape
+    run's root aggregates bit-for-bit. A plain ``jnp.sum`` re-shapes
+    its reduction tree with the array length, changing last-bit
+    rounding when only the padded width changed (observed as 1-ulp
+    root internal_value drift)."""
+    n = v.shape[0]
+    pad = (-n) % _SUM_BLOCK
+    if pad:
+        v = jnp.concatenate([v, jnp.zeros(pad, v.dtype)])
+    bs = jnp.sum(v.reshape(-1, _SUM_BLOCK), axis=1)
+    if bs.shape[0] == 1:
+        return bs[0]
+    return jax.lax.fori_loop(
+        1, bs.shape[0], lambda i, acc: acc + bs[i], bs[0])
+
+
 def _mix32(x: jax.Array) -> jax.Array:
     """lowbias32 integer finalizer (uint32 -> well-mixed uint32) — the
     stochastic-rounding hash. Wrapping uint32 arithmetic everywhere."""
@@ -167,9 +191,19 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                      hist_fn=None, split_fn=None, partition_fn=None,
                      reduce_fn=None, hist_reduce_fn=None,
                      max_reduce_fn=None, row_offset_fn=None, jit=True):
-    """Build ``grow(bins_t, grad, hess, sample_mask, feature_mask)``.
+    """Build ``grow(bins_t, grad, hess, sample_mask, feature_mask,
+    meta=None)``.
 
     bins_t is FEATURE-MAJOR [F, N] (see ops/hist_wave.py).
+
+    ``meta``: optional TRACED FeatureMeta overriding the factory-time
+    constant — the compiled-step registry (ops/step_cache.py) passes
+    the per-booster bin metadata as an argument so two boosters binned
+    on different data share one compiled program. Omitted (the legacy
+    call shape), the factory meta embeds as trace constants exactly as
+    before. The default split/partition seams thread it; INJECTED
+    seams keep their own closure meta (the learners that inject them
+    are not cacheable).
 
     Injection seams for the parallel learners (SURVEY §2.2):
       hist_fn(bins_t, g, h, leaf_ids, wave_leaves) -> [W, F_hist, B, 3]
@@ -200,7 +234,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     W = min(cfg.wave_size, max(L - 1, 1))
     B = cfg.num_bins
     hp = cfg.hp
-    meta = FeatureMeta(*[jnp.asarray(x) for x in meta])
+    meta_const = FeatureMeta(*[jnp.asarray(x) for x in meta])
 
     # fused partition+histogram path (serial mode only: the parallel
     # learners inject their own hist/partition seams)
@@ -245,7 +279,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                      else FUSED_MAX_WAVE_INT8 if quant
                      else FUSED_MAX_WAVE_HILO
                      if cfg.precision == "highest" else FUSED_MAX_WAVE)
-        bundled = jnp.ndim(meta.bundle) != 0
+        bundled = jnp.ndim(meta_const.bundle) != 0
         use_fused = (default_seams and W <= fused_cap
                      and not bundled and _pallas_on(cfg.use_pallas))
     if use_fused:
@@ -261,19 +295,29 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                   gh_scale=gh_scale,
                                   dequant=not defer)
 
-    if split_fn is None:
-        def split_fn(hists, sg, sh, nd, fmask, can):
-            return jax.vmap(
-                lambda hh, a, b, c, d: find_best_split(
-                    hh, a, b, c, fmask, meta, hp, d)
-            )(hists, sg, sh, nd, can)
+    # default split/partition seams take meta as a CALL parameter (the
+    # compiled-step registry passes a traced override); injected seams
+    # keep their original signature and closure meta — the learners
+    # that inject them never cache-share across boosters
+    user_split_fn, user_partition_fn = split_fn, partition_fn
 
-    if partition_fn is None:
-        def partition_fn(bins_t, leaf_ids, wl, new_ids, feat, tbin,
-                         dleft, active, iscat=None, catw=None):
-            return apply_wave_splits(bins_t, leaf_ids, wl, new_ids, feat,
-                                     tbin, dleft, active, meta,
-                                     iscat, catw)
+    def split_fn(hists, sg, sh, nd, fmask, can, meta):
+        if user_split_fn is not None:
+            return user_split_fn(hists, sg, sh, nd, fmask, can)
+        return jax.vmap(
+            lambda hh, a, b, c, d: find_best_split(
+                hh, a, b, c, fmask, meta, hp, d)
+        )(hists, sg, sh, nd, can)
+
+    def partition_fn(bins_t, leaf_ids, wl, new_ids, feat, tbin,
+                     dleft, active, meta, iscat=None, catw=None):
+        if user_partition_fn is not None:
+            return user_partition_fn(bins_t, leaf_ids, wl, new_ids,
+                                     feat, tbin, dleft, active, iscat,
+                                     catw)
+        return apply_wave_splits(bins_t, leaf_ids, wl, new_ids, feat,
+                                 tbin, dleft, active, meta,
+                                 iscat, catw)
 
     if reduce_fn is None:
         def reduce_fn(x):
@@ -313,15 +357,18 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                          h2[..., 1] / jnp.float32(sh)) / 127.0
         return jnp.concatenate([h2, lb[..., None]], axis=-1)
 
-    def grow(bins_t, grad, hess, sample_mask, feature_mask):
+    def grow(bins_t, grad, hess, sample_mask, feature_mask, meta=None):
         """Grow one tree.
 
         bins_t: [F, N] int bins (feature-major); grad/hess: [N] f32;
         sample_mask: [N] f32 0/1 bagging membership;
-        feature_mask: [F] bool usable features this tree.
+        feature_mask: [F] bool usable features this tree;
+        meta: optional traced FeatureMeta override (step_cache path) —
+        None keeps the factory-time constants.
         Returns (TreeRecord, leaf_ids[N]) — leaf_ids of ALL rows
         (out-of-bag included) for score updates.
         """
+        meta = meta_const if meta is None else meta
         F, n = bins_t.shape
         f32 = jnp.float32
         if cfg.packed4:
@@ -457,18 +504,20 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 def acc(v):
                     return jnp.sum(v.astype(jnp.int32)).astype(f32)
             else:
-                acc = jnp.sum
+                acc = _stable_sum
             root_g = reduce_fn(acc(hg)) * gh_scale[0]
             root_h = reduce_fn(acc(hh)) * gh_scale[1]
         else:
-            root_g = reduce_fn(jnp.sum(grad))
-            root_h = reduce_fn(jnp.sum(hess))
+            # shape-stable sums: bucket-padded and exact-shape boosters
+            # must agree bit-for-bit (ops/step_cache.py row bucketing)
+            root_g = reduce_fn(_stable_sum(grad))
+            root_h = reduce_fn(_stable_sum(hess))
         root_c = reduce_fn(jnp.sum(sample_mask))
         if proxy:
             root_hist = bound_counts(root_hist, gh_scale)
         root_split = split_fn(
             root_hist[:1], root_g[None], root_h[None], root_c[None],
-            feature_mask, depth_ok(jnp.zeros(1, jnp.int32)))
+            feature_mask, depth_ok(jnp.zeros(1, jnp.int32)), meta)
 
         def set0(arr, v):
             return arr.at[0].set(v[0] if v.ndim else v)
@@ -586,7 +635,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             else:
                 leaf_ids = partition_fn(bins_t, state.leaf_ids, wl,
                                         new_ids, feat, tbin, dleft,
-                                        active, iscat, catw)
+                                        active, meta, iscat, catw)
                 hist_small = dq(hist_reduce_fn(
                     call_hist(bins_t, bag_mask_ids(leaf_ids),
                               small_ids)))
@@ -666,7 +715,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             sh2 = jnp.concatenate([lh, rh])
             nd2 = jnp.concatenate([lcnt_x, rcnt_x])
             can2 = jnp.concatenate([active & depth_ok(child_depth)] * 2)
-            res = split_fn(hists2, sg2, sh2, nd2, feature_mask, can2)
+            res = split_fn(hists2, sg2, sh2, nd2, feature_mask, can2,
+                           meta)
             gain2 = jnp.where(jnp.isfinite(res.gain), res.gain,
                               KMIN_SCORE)
             idx2 = jnp.concatenate([wl_s, new_s])
@@ -722,7 +772,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             iscat0 = jnp.zeros(W, bool)
             catw0 = jnp.zeros((W, 8), jnp.int32)
             leaf_ids = partition_fn(bins_t, state.leaf_ids, wl, new_ids,
-                                    feat, tbin, dleft, active,
+                                    feat, tbin, dleft, active, meta,
                                     iscat0, catw0)
             # left child keeps the parent id: histogram it directly,
             # sibling by subtraction (sizes don't matter here)
@@ -793,7 +843,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             sh2 = jnp.concatenate([lh, rh])
             nd2 = jnp.concatenate([lcnt, rcnt])
             can2 = jnp.concatenate([active & depth_ok(child_depth)] * 2)
-            res = split_fn(hists2, sg2, sh2, nd2, feature_mask, can2)
+            res = split_fn(hists2, sg2, sh2, nd2, feature_mask, can2,
+                           meta)
             gain2 = jnp.where(jnp.isfinite(res.gain), res.gain,
                               KMIN_SCORE)
             idx2 = jnp.concatenate([wl_s, new_s])

@@ -198,12 +198,32 @@ def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                             reduce_fn=reduce_fn,
                             max_reduce_fn=max_reduce_fn,
                             row_offset_fn=row_offset_fn, jit=False)
+    # meta rides the shard_map as a REPLICATED argument (not a trace
+    # constant) so the compiled-step registry (ops/step_cache.py) can
+    # share one compiled program between boosters binned on different
+    # data; legacy 5-arg callers get the factory meta passed for them
+    meta_dev = FeatureMeta(*[jnp.asarray(a) for a in meta])
+    meta_specs = FeatureMeta(*[P(*([None] * jnp.ndim(a)))
+                               for a in meta_dev])
     sharded = _shard_map(
         grow, mesh=mesh,
-        in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P(None)),
+        in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P(None),
+                  meta_specs),
         out_specs=(P(), P(AXIS)),
         check_vma=False)
-    return jax.jit(sharded)
+    jitted = jax.jit(sharded)
+
+    def call(bins_t, g, h, mask, fmask, meta=None):
+        return jitted(bins_t, g, h, mask, fmask,
+                      meta_dev if meta is None else meta)
+
+    def lower(*args):
+        # jit-object surface for introspection tests/tools: legacy
+        # 5-arg callers get the factory meta appended, like call()
+        return jitted.lower(*(args if len(args) == 6
+                              else args + (meta_dev,)))
+    call.lower = lower
+    return call
 
 
 def make_feature_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,

@@ -47,6 +47,12 @@ if os.environ.get("LGBM_TPU_TEST_COMPILE_CACHE"):
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/lgbm_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# NOTE: jax_disable_most_optimizations was evaluated for the
+# compile-bound suite (compiles are ~60% of a typical engine-test
+# slice even with the cross-booster step cache) and rejected: it
+# halves compile time but de-optimizes the RUNTIME code so badly that
+# iteration-heavy tests (DART replay, CV) dominate — the full suite
+# got slower, not faster.
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -89,6 +95,26 @@ def fit_gbdt(X, y, params, num_round=30, weight=None, group=None,
             break
     g.finish_training()
     return g
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _step_cache_suite_guard():
+    """Regression guard for the compiled-step registry
+    (ops/step_cache.py): a full suite run trains hundreds of boosters
+    in one process, many with identical geometry — if the registry
+    records plenty of misses but not a single hit, a closure
+    re-capture regression has silently put every booster back on its
+    own compile (the ~19 min PR-4 wall-clock). Small selections that
+    train only a handful of boosters stay under the miss threshold and
+    are exempt."""
+    yield
+    from lightgbm_tpu.ops import step_cache
+    s = step_cache.stats()
+    if s["enabled"] and s["misses"] > 20:
+        assert s["hits"] > 0, (
+            "step cache recorded %(misses)d compiles and ZERO hits "
+            "across the suite — cross-booster step reuse has regressed "
+            "(every booster is re-compiling its fused step)" % s)
 
 
 @pytest.fixture(scope="session")

@@ -57,7 +57,7 @@ class TestRF:
         gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10,
                         verbose_eval=False)
         raw = gbm.predict(X, raw_score=True)
-        train_scores = np.asarray(gbm._gbdt._scores)[0]
+        train_scores = np.asarray(gbm._gbdt.train_scores())[0]
         np.testing.assert_allclose(raw, train_scores, atol=1e-4)
 
     def test_rf_multiclass(self):
@@ -152,7 +152,7 @@ class TestDART:
         gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=15,
                         verbose_eval=False, keep_training_booster=True)
         raw = gbm.predict(X, raw_score=True)
-        train_scores = np.asarray(gbm._gbdt._scores)[0]
+        train_scores = np.asarray(gbm._gbdt.train_scores())[0]
         np.testing.assert_allclose(raw, train_scores, rtol=1e-4,
                                    atol=1e-4)
 

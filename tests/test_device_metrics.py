@@ -76,7 +76,7 @@ def test_training_uses_device_eval():
                             metric="auc,binary_logloss"), num_round=8)
     assert g._device_eval_fn(0, g.training_metrics) is not None
     got = {n: v for n, v, _ in g.get_eval_at(0)}
-    raw = np.asarray(g._scores)
+    raw = np.asarray(g.train_scores())
     for m in g.training_metrics:
         for name, want in m.eval(raw, g.objective):
             np.testing.assert_allclose(got[name], want, rtol=2e-5,

@@ -49,7 +49,7 @@ class TestBinary:
     def test_prediction_matches_internal_score(self, binary_model):
         g, X, y = binary_model
         p = g.predict_raw(X)
-        internal = np.asarray(g._scores[0])
+        internal = np.asarray(g.train_scores()[0])
         np.testing.assert_allclose(p, internal, rtol=1e-4, atol=1e-5)
 
     def test_predict_probability_range(self, binary_model):

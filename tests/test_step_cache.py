@@ -1,0 +1,296 @@
+"""Compiled-step registry suite (ops/step_cache.py): cross-booster
+reuse proven by registry counters, and bit-parity of bucket-padded
+training against exact-shape training across the eligibility matrix
+(bagging, valid sets, quantized histograms, weights, renew objectives,
+data-parallel learner). Run with ``pytest -m stepcache``.
+
+Parity here is between the SHARED-step programs (tpu_row_bucket=-1 vs
+0): that is the invariant the registry relies on — a booster served
+from the cache must produce exactly what it would have compiled for
+itself. The legacy per-instance closure (tpu_step_cache=0) is checked
+too where the suite historically guaranteed it (K=1 objectives); for
+multiclass, XLA's whole-program fusion can flip an exactly-tied
+zero-gain split between the two PROGRAM SHAPES (observed as an
+output-neutral extra leaf), so the legacy check there is on
+predictions, not model text.
+"""
+import numpy as np
+import pytest
+
+from conftest import (TEST_PARAMS, fit_gbdt, make_binary,
+                      make_multiclass, make_regression)
+from lightgbm_tpu.ops import step_cache
+
+pytestmark = pytest.mark.stepcache
+
+
+def trees(g):
+    """Model text minus the parameters section (the tpu_step_cache /
+    tpu_row_bucket knobs legitimately differ between parity runs)."""
+    return g.model_to_string().split("parameters:")[0]
+
+
+def stats_delta(fn):
+    s0 = step_cache.stats()
+    out = fn()
+    s1 = step_cache.stats()
+    return out, {k: s1[k] - s0[k] for k in ("hits", "misses")}
+
+
+def test_cross_booster_reuse_exact_counters():
+    """Two boosters with identical geometry compile the fused step
+    exactly once — the second is a pure registry hit."""
+    X, y = make_binary(640, seed=11)
+    g1, d1 = stats_delta(
+        lambda: fit_gbdt(X, y, {"objective": "binary"}, num_round=4))
+    g2, d2 = stats_delta(
+        lambda: fit_gbdt(X, y, {"objective": "binary"}, num_round=4))
+    assert d2["misses"] == 0, "second booster must not recompile"
+    assert d2["hits"] >= 1
+    assert trees(g1) == trees(g2)
+
+
+def test_same_bucket_different_n_shares_step():
+    """Row counts landing in the same power-of-two bucket share one
+    compiled step; the padded run is bit-exact vs its own exact-shape
+    run."""
+    X, y = make_binary(1280, seed=12)
+    _, d1 = stats_delta(
+        lambda: fit_gbdt(X, y, {"objective": "binary"}, num_round=4))
+    gb, d2 = stats_delta(
+        lambda: fit_gbdt(X[:1100], y[:1100], {"objective": "binary"},
+                         num_round=4))
+    assert d2["misses"] == 0, \
+        "n=1100 and n=1280 land in the same 2048 bucket"
+    ge = fit_gbdt(X[:1100], y[:1100],
+                  {"objective": "binary", "tpu_row_bucket": 0},
+                  num_round=4)
+    assert trees(gb) == trees(ge)
+
+
+@pytest.mark.parametrize("name,params,kwargs", [
+    ("bagging", {"objective": "binary", "bagging_freq": 2,
+                 "bagging_fraction": 0.7}, {}),
+    ("valid", {"objective": "binary"}, {"valid": True}),
+    ("quantized", {"objective": "binary",
+                   "tpu_quantized_hist": True}, {}),
+    ("weights", {"objective": "regression"}, {"weight": True}),
+    ("l1_renew", {"objective": "regression_l1"}, {}),
+])
+def test_bucket_padding_bit_parity(name, params, kwargs):
+    """Bucket-padded training (tpu_row_bucket=-1) is bit-exact vs
+    exact shapes (tpu_row_bucket=0) AND vs the legacy per-instance
+    closure (tpu_step_cache=0)."""
+    if params["objective"].startswith("regression"):
+        X, y = make_regression(1280, seed=13)
+    else:
+        X, y = make_binary(1280, seed=13)
+    kw = {}
+    if kwargs.get("valid"):
+        kw["valid"] = (X[:320], y[:320])
+    if kwargs.get("weight"):
+        r = np.random.default_rng(5)
+        kw["weight"] = (np.abs(r.normal(size=1280)) + 0.5).astype(
+            np.float32)
+    gb = fit_gbdt(X, y, params, num_round=5, **kw)
+    ge = fit_gbdt(X, y, dict(params, tpu_row_bucket=0), num_round=5,
+                  **kw)
+    gl = fit_gbdt(X, y, dict(params, tpu_step_cache=0), num_round=5,
+                  **kw)
+    assert trees(gb) == trees(ge), f"{name}: bucket != exact"
+    assert trees(gb) == trees(gl), f"{name}: cached != legacy"
+
+
+def test_data_parallel_reuse_and_legacy_parity():
+    """The sharded f32 data learner caches at exact shapes (bucketing
+    would regroup the cross-shard f32 psums): same-N boosters share
+    one step, and the shared step matches the legacy closure."""
+    X, y = make_binary(1280, seed=14)
+    params = {"objective": "binary", "tree_learner": "data"}
+    gb, _ = stats_delta(lambda: fit_gbdt(X, y, params, num_round=4))
+    _, d2 = stats_delta(lambda: fit_gbdt(X, y, params, num_round=4))
+    assert d2["misses"] == 0
+    assert d2["hits"] >= 1
+    gl = fit_gbdt(X, y, dict(params, tpu_step_cache=0), num_round=4)
+    assert trees(gb) == trees(gl)
+
+
+def test_data_parallel_quantized_bucket_parity():
+    """Quantized data-parallel training buckets: the int32 histogram
+    wire and integer root sums are grouping-invariant, so the padded
+    run is bit-exact vs exact shapes even though the shard boundaries
+    moved."""
+    X, y = make_binary(1280, seed=19)
+    params = {"objective": "binary", "tree_learner": "data",
+              "tpu_quantized_hist": True}
+    gb = fit_gbdt(X, y, params, num_round=4)
+    assert gb._n_score > gb._n, "quantized data mode must bucket"
+    ge = fit_gbdt(X, y, dict(params, tpu_row_bucket=0), num_round=4)
+    assert trees(gb) == trees(ge)
+
+
+def test_multiclass_bucket_parity():
+    """K>1: bucket-vs-exact stays bit-exact within the shared path;
+    vs the legacy program shape, predictions (not borderline zero-gain
+    splits) are the guarantee."""
+    X, y = make_multiclass(1280, seed=15)
+    params = {"objective": "multiclass", "num_class": 4}
+    gb = fit_gbdt(X, y, params, num_round=4)
+    ge = fit_gbdt(X, y, dict(params, tpu_row_bucket=0), num_round=4)
+    assert trees(gb) == trees(ge)
+    gl = fit_gbdt(X, y, dict(params, tpu_step_cache=0), num_round=4)
+    np.testing.assert_array_equal(gb.predict(X[:256]),
+                                  gl.predict(X[:256]))
+
+
+def test_step_cache_off_knob():
+    """tpu_step_cache=0 keeps the legacy closure: no registry
+    traffic."""
+    X, y = make_binary(512, seed=16)
+    _, d = stats_delta(
+        lambda: fit_gbdt(X, y, {"objective": "binary",
+                                "tpu_step_cache": 0}, num_round=3))
+    assert d["misses"] == 0 and d["hits"] == 0
+
+
+def test_custom_gradients_cached():
+    """Objective-less boosters (custom fobj gradients) ride the shared
+    step with grad_fn=None; parity with the legacy closure holds."""
+    X, y = make_regression(700, seed=17)
+
+    def run(extra):
+        def go():
+            import conftest as _c
+            from lightgbm_tpu.config import Config
+            from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+            from lightgbm_tpu.models.gbdt import GBDT
+            p = dict(TEST_PARAMS)
+            p.update({"objective": "none"})
+            p.update(extra)
+            cfg = Config().set(p)
+            ds = TpuDataset(cfg).construct_from_matrix(
+                X, Metadata(label=y))
+            g = GBDT()
+            g.init(cfg, ds, None, ())
+            for _ in range(3):
+                s = np.asarray(g.train_scores())[0]
+                g.train_one_iter(grad=(s - y).astype(np.float32),
+                                 hess=np.ones_like(y, np.float32))
+            g.finish_training()
+            return g
+        return go
+    gb, _ = stats_delta(run({}))
+    _, d2 = stats_delta(run({}))
+    assert d2["misses"] == 0
+    gl = fit_gbdt  # noqa: F841  (uniform style)
+    ge, _ = stats_delta(run({"tpu_step_cache": 0}))
+    assert trees(gb) == trees(ge)
+
+
+def test_reset_parameter_cannot_flip_step_implementation():
+    """A mid-life reset_parameter that flips a step-cache knob must
+    NOT switch step implementations: the live buffers are frozen at
+    the widths chosen at init (the legacy closure cannot consume a
+    bucketed score width)."""
+    import lightgbm_tpu as lgb
+    X, y = make_binary(1000, seed=21)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "max_bin": 31, "verbosity": -1},
+                    lgb.Dataset(X, y), num_boost_round=2,
+                    verbose_eval=False, keep_training_booster=True)
+    g = bst._gbdt
+    assert g._cache_eligible and g._n_score > g._n
+    bst.reset_parameter({"tpu_step_cache": 0, "learning_rate": 0.05})
+    bst.update()                      # crashed before the freeze
+    assert g._cache_eligible, "implementation flipped mid-life"
+    assert g._n_score > g._n
+    assert len(g.records) == 3
+
+
+def test_ineligible_variants_keep_legacy():
+    """GOSS opts out (its in-jit sampler is positional in the row
+    width) — no registry traffic, and the booster still trains."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.models.boosting import create_boosting
+    from lightgbm_tpu.objectives import create_objective
+    X, y = make_binary(640, seed=18)
+    p = dict(TEST_PARAMS)
+    p.update({"objective": "binary", "boosting": "goss",
+              "top_rate": 0.3, "other_rate": 0.3})
+    cfg = Config().set(p)
+    ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+    obj = create_objective("binary", cfg)
+    obj.init(ds.metadata, ds.num_data)
+
+    def go():
+        g = create_boosting("goss")
+        g.init(cfg, ds, obj, ())
+        for _ in range(3):
+            g.train_one_iter()
+        return g
+    g, d = stats_delta(go)
+    assert d["misses"] == 0 and d["hits"] == 0
+    assert not g._cache_eligible
+    assert g._n_score == g._n
+    assert len(g.records) == 3
+
+
+def test_lrb_two_window_smoke():
+    """Two sliding windows of the paper workload: fresh booster per
+    window, ONE compile for the run — every window after the first is
+    a registry hit with ~zero compile time (windows differ in observed
+    bin counts AND surviving feature counts, so this exercises the B/F
+    geometry bucketing, not just row bucketing)."""
+    from lightgbm_tpu.lrb import LrbDriver, synthetic_trace
+    import io
+    out = io.StringIO()
+    drv = LrbDriver(cache_size=1 << 16, window_size=512,
+                    sample_size=256, cutoff=0.5, sampling=1,
+                    result_file=out)
+    for seq, oid, size, cost in synthetic_trace(1024, n_objects=60):
+        drv.process_request(seq, oid, size, cost)
+    assert len(drv.results) == 2
+    assert drv.booster is not None
+    trained = [r for r in drv.results if "train_s" in r]
+    assert trained, "at least one window must have trained a model"
+    assert all(r["compile_s"] >= 0 for r in trained)
+    # amortization: windows after the first must NOT recompile
+    for r in trained[1:]:
+        assert r["step_cache_hits"] >= 1, \
+            "later window re-compiled — geometry key drifted"
+        assert r["compile_s"] < 1.0
+    # the second window evaluates the first window's model
+    assert "fp_rate" in drv.results[1]
+
+
+def test_geometry_bucketing_shares_across_data_shapes():
+    """The observed max bin count AND the surviving feature count are
+    data-dependent (trivial columns are excluded) — the B/F axis
+    buckets (pow2 bins, mult-of-8 features) make boosters trained on
+    differently-shaped windows share ONE step, bit-exactly vs the
+    legacy exact-shape closure."""
+    rng = np.random.default_rng(11)
+    n = 1280
+    # 10 informative + 1 constant column -> F=10 after trivial
+    # exclusion (pads to 16); ~40 distinct levels -> B!=pow2 (pads 64)
+    X = np.round(rng.normal(size=(n, 11)) * 6).clip(-20, 19)
+    X[:, 7] = 3.0
+    w = rng.normal(size=11)
+    w[7] = 0
+    y = ((X @ w + rng.normal(size=n) * 0.5) > 0).astype(np.float32)
+    params = {"objective": "binary", "bagging_freq": 2,
+              "bagging_fraction": 0.8}
+    gb, _ = stats_delta(lambda: fit_gbdt(X, y, params, num_round=5))
+    assert gb._f_pad % 8 == 0 and gb._f_pad > gb.train_data.num_features
+    assert gb._grower_cfg.num_bins == 64
+    gl = fit_gbdt(X, y, dict(params, tpu_step_cache=0), num_round=5)
+    assert gl._f_pad == gl.train_data.num_features
+    assert trees(gb) == trees(gl), "padded F/B drifted vs legacy"
+    # different observed bins (50 levels) AND features (12, no trivial
+    # column): same (16, 64) bucket -> pure registry hit
+    X2 = np.round(rng.normal(size=(n, 12)) * 8).clip(-25, 24)
+    y2 = ((X2 @ rng.normal(size=12)) > 0).astype(np.float32)
+    _, d2 = stats_delta(lambda: fit_gbdt(X2, y2, params, num_round=5))
+    assert d2["misses"] == 0, "same-bucket shapes must share the step"
+    assert d2["hits"] >= 1
