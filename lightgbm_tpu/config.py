@@ -393,6 +393,36 @@ class Config:
     # recompiling. 1 = opt in on jax >= 0.5 (where the deserializer is
     # fixed); ignored with a warning on older jax. 0 = off (default).
     tpu_compile_cache_cpu: int = 0
+    # cross-thread span trace (obs/trace.py): write a Chrome
+    # trace-event / Perfetto-loadable JSON here showing ingest worker
+    # chunks, training iterations, step-cache compiles/hits, watchdog
+    # firings and (lrb.py) per-window derive/train/evaluate spans with
+    # correct pid/tid across threads. Flushed at run finish, after
+    # every lrb window, and at interpreter exit. Empty = off.
+    tpu_trace: str = ""
+    # span-trace ring capacity in EVENTS (obs/trace.py): the buffer
+    # keeps the most recent N events, so a million-iteration serving
+    # loop traces its tail instead of growing without bound (dropped
+    # count recorded in the file's metadata). Floor 1024.
+    tpu_trace_buffer: int = 65536
+    # live metrics export (obs/export.py): base path for periodic
+    # registry snapshots — "<base>.prom" (Prometheus text exposition,
+    # atomically replaced) and "<base>.jsonl" (append-only time
+    # series) are written every tpu_metrics_interval_s DURING the run,
+    # so a live loop can be watched without waiting for the run
+    # report. A .prom/.jsonl suffix on the value is stripped.
+    # Empty = off (unless tpu_metrics_port opens the HTTP endpoint).
+    tpu_metrics_export: str = ""
+    # seconds between exporter snapshots (obs/export.py); also the
+    # flush cadence of the JSONL time series. Non-positive values fall
+    # back to the 5.0 default; the exporter floors tiny values at 0.01.
+    tpu_metrics_interval_s: float = 5.0
+    # serve live metrics over HTTP (obs/export.py): a stdlib
+    # http.server on 127.0.0.1:<port> answering GET /metrics
+    # (Prometheus text) and /metrics.json (raw snapshot) while the
+    # process runs — point a scraper at a live training/serving loop.
+    # 0 = off.
+    tpu_metrics_port: int = 0
 
     def __post_init__(self):
         self._raw_params: Dict[str, str] = {}
@@ -568,6 +598,18 @@ class Config:
             log.warning("tpu_compile_cache_cpu=%d is not 0/1; using 0 "
                         "(off)", self.tpu_compile_cache_cpu)
             self.tpu_compile_cache_cpu = 0
+        if self.tpu_trace_buffer < 1024:
+            log.warning("tpu_trace_buffer=%d is below the floor; "
+                        "using 1024", self.tpu_trace_buffer)
+            self.tpu_trace_buffer = 1024
+        if self.tpu_metrics_interval_s <= 0:
+            log.warning("tpu_metrics_interval_s=%g is not positive; "
+                        "using 5.0", self.tpu_metrics_interval_s)
+            self.tpu_metrics_interval_s = 5.0
+        if not 0 <= self.tpu_metrics_port <= 65535:
+            log.warning("tpu_metrics_port=%d is not a port; disabling "
+                        "the metrics endpoint (0)", self.tpu_metrics_port)
+            self.tpu_metrics_port = 0
         if self.is_provide_training_metric or self.valid:
             if not self.metric:
                 # force defaults from objective later; handled by metric factory

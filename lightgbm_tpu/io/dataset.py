@@ -179,6 +179,11 @@ class TpuDataset:
         the distributed loader's synced mappers
         (dataset_loader.cpp:434-466 Allgather of serialized BinMappers).
         """
+        # span trace starts HERE when configured (obs/trace.py): ingest
+        # runs before any booster exists, and its worker-thread spans
+        # must land in the same buffer the training spans will
+        from ..obs import trace
+        trace.ensure_from_config(self.config)
         X = np.asarray(X)
         if X.dtype not in (np.float32, np.float64):
             X = X.astype(np.float64)

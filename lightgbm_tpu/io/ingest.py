@@ -70,6 +70,7 @@ from typing import List, Sequence
 import numpy as np
 
 from ..obs import registry as obs
+from ..obs import trace
 from ..utils import log, timing
 from .binning import BinMapper, BinType, MissingType
 
@@ -139,7 +140,8 @@ def prefetch(thunks, depth: int = 2):
     worker slices/keys chunk k+1. One thread is deliberate: host prep
     is memory-bandwidth bound and the results must stay ordered."""
     it = iter(thunks)
-    with concurrent.futures.ThreadPoolExecutor(max_workers=1) as ex:
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ingest-prefetch") as ex:
         q: collections.deque = collections.deque()
         try:
             for _ in range(max(depth, 1)):
@@ -369,6 +371,11 @@ class DeviceBinner:
         """Slice + key one chunk on the host (worker-thread half of the
         double buffer). Returns the transfer tuple, tail-padded to the
         fixed chunk shape so every chunk reuses one compiled kernel."""
+        with trace.span("ingest/prep_chunk", cat="ingest",
+                        args={"rows": int(X.shape[0])}):
+            return self._prep_chunk_inner(X)
+
+    def _prep_chunk_inner(self, X: np.ndarray):
         C = self.chunk_rows
         k = X.shape[0]
         pad = C - k
@@ -406,13 +413,15 @@ class DeviceBinner:
         import jax
         (xa, xb, nan, cat_iv), k = prepped
         nbytes = sum(int(a.nbytes) for a in (xa, xb, nan, cat_iv))
-        with timing.phase("binning/device_xfer"):
-            xa, xb, nan, cat_iv = jax.device_put(
-                (xa, xb, nan, cat_iv), device)
-        obs.counter("ingest/h2d_bytes").add(nbytes)
-        obs.counter("ingest/h2d_chunks").add(1)
-        obs.counter("ingest/rows_device").add(k)
-        out = self._chunk_fn(xa, xb, nan, cat_iv)
+        with trace.span("ingest/chunk", cat="ingest",
+                        args={"rows": int(k), "bytes": nbytes}):
+            with timing.phase("binning/device_xfer"):
+                xa, xb, nan, cat_iv = jax.device_put(
+                    (xa, xb, nan, cat_iv), device)
+            obs.counter("ingest/h2d_bytes").add(nbytes)
+            obs.counter("ingest/h2d_chunks").add(1)
+            obs.counter("ingest/rows_device").add(k)
+            out = self._chunk_fn(xa, xb, nan, cat_iv)
         if k < self.chunk_rows:
             out = out[:, :k]
         return out

@@ -105,6 +105,14 @@ class GBDT:
         # process-wide compiled-step registry (ops/step_cache.py):
         # eligible boosters share ONE jitted training step per geometry
         step_cache.configure(config.tpu_step_cache, config.tpu_row_bucket)
+        # streaming telemetry (obs/): the span tracer and the live
+        # metrics exporter are process-global daemons — the first
+        # booster with the knobs set starts them, every later one
+        # (each sliding window's fresh booster) joins
+        from ..obs import export as obs_export
+        from ..obs import trace as obs_trace
+        obs_trace.ensure_from_config(config)
+        obs_export.ensure_from_config(config)
         self.objective = objective
         self.training_metrics = list(training_metrics)
         self.iter_ = 0
@@ -1022,6 +1030,23 @@ class GBDT:
                 dict(zip(type(meta_dev)._fields, meta_dev))),
         )
 
+    @staticmethod
+    def _renew_aux(obj):
+        """(renew_alpha, host renew-aux dict) for objectives that
+        refit leaf outputs (the L1 family), else (None, None) — the
+        ONE source of the label/weight plumbing for BOTH step
+        routings (registry + legacy), so they cannot drift."""
+        if not obj.is_renew_tree_output():
+            return None, None
+        lbl = (obj.trans_label if hasattr(obj, "trans_label")
+               else obj.label)
+        w = getattr(obj, "label_weight", None)
+        if w is None:
+            w = obj.weights
+        return (float(obj.renew_tree_output_percentile()),
+                {"label": np.asarray(lbl, np.float32),
+                 "w": None if w is None else np.asarray(w, np.float32)})
+
     def _get_cached_step(self, custom: bool):
         """Fetch (or build once per geometry, process-wide) the shared
         fused step and bind this booster's rvalid/meta/aux arguments."""
@@ -1032,21 +1057,12 @@ class GBDT:
         obj = self.objective
         grad_fn = (None if custom or obj is None
                    else obj.gradient_builder())
-        renew = grad_fn is not None and obj.is_renew_tree_output()
-        renew_alpha = (float(obj.renew_tree_output_percentile())
-                       if renew else None)
-        aux_host = {"obj": None, "renew": None}
+        renew_alpha = aux_renew = None
+        if grad_fn is not None:
+            renew_alpha, aux_renew = self._renew_aux(obj)
+        aux_host = {"obj": None, "renew": aux_renew}
         if grad_fn is not None:
             aux_host["obj"] = obj.gradient_aux()
-        if renew:
-            lbl = (obj.trans_label if hasattr(obj, "trans_label")
-                   else obj.label)
-            w = getattr(obj, "label_weight", None)
-            if w is None:
-                w = obj.weights
-            aux_host["renew"] = {
-                "label": np.asarray(lbl, np.float32),
-                "w": None if w is None else np.asarray(w, np.float32)}
         aux_dev = self._pad_step_aux(aux_host)
         meta = self._meta
         meta_dev = type(meta)(*[jnp.asarray(x) for x in meta])
@@ -1090,108 +1106,65 @@ class GBDT:
         Eligible configurations route to the PROCESS-WIDE registry
         (ops/step_cache.py via _get_cached_step): the step is a pure
         function of a geometry key and is compiled once per geometry,
-        not once per booster. Ineligible ones keep this per-instance
-        closure. Retraces only when a valid set is added or the
-        custom-gradient mode flips; shrinkage/init-bias are traced
-        arguments.
+        not once per booster. Ineligible ones get a per-instance jit of
+        the SAME step body (step_cache.build_train_step with
+        rvalid/meta=None — one implementation, two routings). Retraces
+        only when a valid set is added or the custom-gradient mode
+        flips; shrinkage/init-bias are traced arguments.
         """
         if getattr(self, "_cache_eligible", False):
             return self._get_cached_step(custom)
+        # legacy per-booster closure (GOSS/EFB/feature/voting/
+        # tpu_step_cache=0): SAME step body as the registry path
+        # (step_cache.build_train_step — one implementation, two
+        # routings), but jitted per-instance with exact row shapes:
+        # rvalid=None (no bucketing pad to mask) and meta=None (the
+        # grower consumes its own closure metadata, which the
+        # cache-ineligible learner seams require).
         key = (custom, len(self._valid_bins_dev))
         if getattr(self, "_step_key", None) == key:
             return self._step_fn
+        from ..ops import step_cache
         obj = self.objective
-        grower = self._grower
         K = self.num_tree_per_iteration
-        n = self._n
-        pad_rows = self._n_total - n
-        valid_slices = tuple(self._valid_row_slices)
-        meta = self._meta
-        L = self._grower_cfg.num_leaves
-        renew = (not custom) and obj is not None \
-            and obj.is_renew_tree_output()
-        if renew:
-            from ..ops.renew import renew_leaf_outputs
-            renew_label = jnp.asarray(
-                obj.trans_label if hasattr(obj, "trans_label")
-                else obj.label, jnp.float32)
-            w = getattr(obj, "label_weight", None)
-            if w is None:
-                w = obj.weights
-            renew_w = None if w is None else jnp.asarray(w, jnp.float32)
-            renew_alpha = float(obj.renew_tree_output_percentile())
+        if custom or obj is None:
+            grad_fn = None
+        else:
+            # closure-gradient seam: same get_gradients the objective's
+            # pure gradient_builder delegates to, so the two routes
+            # cannot drift (objectives/objective.py)
+            def grad_fn(scores, _aux_obj, _obj=obj):
+                return _obj.get_gradients(scores)
+        renew_alpha = aux_renew = None
+        if grad_fn is not None:
+            renew_alpha, aux_renew = self._renew_aux(obj)
+        aux = {"obj": None, "renew": None}
+        if aux_renew is not None:
+            aux["renew"] = {k: (None if v is None else jnp.asarray(v))
+                            for k, v in aux_renew.items()}
+        # bins (and the aux arrays) are ARGUMENTS, not closure
+        # constants: closed-over arrays embed into the lowered program,
+        # and at 11M rows the 308 MB constant blows the compile-RPC
+        # size limit. Valid rows ride INSIDE ``bins`` as weight-0
+        # passenger rows (_rebuild_grower_bins): the grower's partition
+        # hands every valid row its leaf id, so the per-iteration
+        # valid-score update is a slice + leaf-output gather instead of
+        # a num_leaves-deep split replay per tree.
+        shared = step_cache.build_train_step(
+            grower=self._grower, K=K, n_score=self._n,
+            n_total=self._n_total,
+            valid_slices=tuple(self._valid_row_slices),
+            num_leaves=self._grower_cfg.num_leaves,
+            grad_fn=grad_fn, renew_alpha=renew_alpha,
+            sample_hook=self._sample_hook)
 
-        sample_hook = self._sample_hook
+        def stepfn(bins, scores, valid_scores, mask, fmask, shrink,
+                   init_bias, g_in, h_in, prng):
+            return shared(bins, scores, valid_scores, mask, fmask,
+                          shrink, init_bias, g_in, h_in, prng,
+                          None, None, aux)
 
-        # bins are an ARGUMENT, not a closure constant: closed-over
-        # arrays embed into the lowered program, and at 11M rows the
-        # 308 MB constant blows the compile-RPC size limit. Valid rows
-        # ride INSIDE ``bins`` as weight-0 passenger rows
-        # (_rebuild_grower_bins): the grower's partition hands every
-        # valid row its leaf id, so the per-iteration valid-score
-        # update is a slice + leaf-output gather instead of a
-        # num_leaves-deep split replay per tree.
-        def step(bins, scores, valid_scores, mask, fmask,
-                 shrink, init_bias, g_in, h_in, key):
-            if custom:
-                g_all, h_all = g_in, h_in
-            else:
-                g_all, h_all = obj.get_gradients(
-                    scores if K > 1 else scores[0])
-                if K == 1:
-                    g_all, h_all = g_all[None, :], h_all[None, :]
-            if sample_hook is not None:
-                # in-jit gradient-based sampling (GOSS): may amplify
-                # g/h and shrink the bagging mask, all device-side
-                g_all, h_all, mask = sample_hook(g_all, h_all, mask, key)
-            recs = []
-            vs = list(valid_scores)
-            for k in range(K):
-                g_k, h_k = g_all[k], h_all[k]
-                if pad_rows:
-                    zpad = jnp.zeros(pad_rows, jnp.float32)
-                    g_k = jnp.concatenate([g_k, zpad])
-                    h_k = jnp.concatenate([h_k, zpad])
-                rec, leaf_full = grower(bins, g_k, h_k, mask, fmask)
-                leaf_ids = leaf_full[:n]
-                if renew:
-                    # objective-driven leaf refit
-                    # (serial_tree_learner.cpp:780-818) against the
-                    # PRE-update scores; splitless trees stay all-zero
-                    # (the reference never renews a tree it is about to
-                    # discard, gbdt.cpp:393-409)
-                    residual = renew_label - scores[k]
-                    new_out = renew_leaf_outputs(
-                        leaf_ids, residual, renew_w, L, renew_alpha,
-                        rec.leaf_output, mask[:n])
-                    new_out = jnp.where(rec.num_leaves > 1, new_out,
-                                        rec.leaf_output)
-                    rec = rec._replace(leaf_output=new_out)
-                # fold shrinkage (Tree::Shrinkage, gbdt.cpp:371)
-                rec = rec._replace(
-                    leaf_output=rec.leaf_output * shrink,
-                    internal_value=rec.internal_value * shrink)
-                # out-of-bag rows included: the partition covers ALL rows
-                scores = scores.at[k].set(add_leaf_outputs(
-                    scores[k], leaf_ids, rec.leaf_output, 1.0))
-                for vi, (voff, vn) in enumerate(valid_slices):
-                    vleaf = leaf_full[voff:voff + vn]
-                    vs[vi] = vs[vi].at[k].set(add_leaf_outputs(
-                        vs[vi][k], vleaf, rec.leaf_output, 1.0))
-                # AddBias on the STORED record only (tree.h:151): the
-                # init score already reached train/valid scores through
-                # BoostFromAverage's AddScore, so the score updates above
-                # use the un-biased outputs. For a splitless first tree
-                # this also yields the reference's constant tree
-                # (leaf0 = init, gbdt.cpp:378-396); biasing unused leaf
-                # slots is harmless (leaf_ids never reference them).
-                rec = rec._replace(
-                    leaf_output=rec.leaf_output + init_bias[k],
-                    internal_value=rec.internal_value + init_bias[k])
-                recs.append(rec)
-            return scores, tuple(vs), recs
-
-        self._step_fn = jax.jit(step, donate_argnums=(1, 2))
+        self._step_fn = stepfn
         self._step_key = key
         return self._step_fn
 
@@ -1210,6 +1183,20 @@ class GBDT:
         a periodic host check (every ``tpu_stop_check_interval``
         iterations).
         """
+        from ..obs import trace
+        tracer = trace.active()
+        if tracer is not None:
+            # iteration span at the single choke point EVERY driver
+            # passes through (gbdt.train, engine/Booster.update, the
+            # capi/lrb per-window loop, bench) — dispatch-issue wall,
+            # like the phase clocks; queued device time drains in the
+            # periodic queue_drain spans
+            with tracer.span("iteration", cat="iteration",
+                             args={"it": self.iter_ + 1}):
+                return self._train_one_iter_inner(grad, hess)
+        return self._train_one_iter_inner(grad, hess)
+
+    def _train_one_iter_inner(self, grad, hess) -> bool:
         K = self.num_tree_per_iteration
         init_scores = [0.0] * K
         custom = grad is not None and hess is not None

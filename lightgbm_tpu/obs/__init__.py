@@ -11,16 +11,26 @@
 - ``obs.profiler`` — jax profiler integration: TraceAnnotation wrapping
   for timing phases and the ``tpu_profile_dir``/``tpu_profile_iters``
   iteration-window trace bracket.
+- ``obs.trace`` — cross-thread span tracer (config ``tpu_trace``/
+  ``tpu_trace_buffer``): ring-buffered Chrome trace-event JSON showing
+  the ingest worker, the training iterations, step-cache compiles and
+  the lrb window phases on one Perfetto timeline.
+- ``obs.export`` — live metrics exporter (``tpu_metrics_export``/
+  ``tpu_metrics_interval_s``/``tpu_metrics_port``): a daemon that
+  snapshots the default registry to Prometheus text + JSONL on an
+  interval and optionally serves ``/metrics`` over HTTP during a run.
 
-Only the registry is imported eagerly (utils/timing.py depends on it at
+Only the stdlib-dependency modules (registry, trace, export) are
+imported eagerly (utils/timing.py depends on registry and trace at
 module load); recorder/profiler import jax-adjacent modules and load on
 first use.
 """
-from . import registry
+from . import export, registry, trace
 from .registry import (MetricsRegistry, counter, default_registry, gauge,
-                       histogram, timer)
+                       histogram, latency_histogram, timer)
 
 __all__ = [
-    "registry", "MetricsRegistry", "default_registry",
-    "counter", "gauge", "histogram", "timer",
+    "registry", "trace", "export", "MetricsRegistry",
+    "default_registry", "counter", "gauge", "histogram",
+    "latency_histogram", "timer",
 ]

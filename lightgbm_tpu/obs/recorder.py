@@ -36,7 +36,6 @@ _comm_bytes_per_iteration) — alongside the cumulative
 from __future__ import annotations
 
 import json
-import os
 import statistics
 import threading
 import time
@@ -45,6 +44,7 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from ..utils import log, timing
+from . import trace
 from .registry import MetricsRegistry, default_registry
 
 RUN_REPORT_SCHEMA = "lightgbm-tpu/run-report"
@@ -189,6 +189,13 @@ class RunRecorder:
             med = statistics.median(recent)
             if med > 0 and wall_s > self.watchdog_factor * med:
                 self._reg.counter("watchdog/slow_iterations").add(1)
+                # instant marker on the trace timeline: a slow
+                # iteration is visible in Perfetto exactly where it
+                # happened, not only as a log line
+                trace.instant("watchdog/slow_iteration", cat="event",
+                              args={"it": int(it),
+                                    "wall_s": round(float(wall_s), 6),
+                                    "median_s": round(float(med), 6)})
                 log.warning(
                     "slow iteration %d: %.3f s vs trailing median "
                     "%.3f s (%.1fx, threshold %.1fx); phase table:\n%s",
@@ -236,6 +243,12 @@ class RunRecorder:
             return {}
         self._finished = True
         log.set_run_context(None)
+        # cross-link report <-> trace: flush the tracer's ring so the
+        # trace on disk covers this run, and record where it went
+        if trace.enabled():
+            trace_path = trace.write()
+            if trace_path:
+                self.meta.setdefault("trace_path", trace_path)
         if leaves_per_iteration is not None:
             for i, grp in enumerate(leaves_per_iteration):
                 self._rec(i + 1)["leaves"] = [int(x) for x in grp]
@@ -274,15 +287,12 @@ class RunRecorder:
         return report
 
     def _write(self, report: dict) -> None:
-        """Atomic write (tmp + rename, the tuning-cache discipline).
+        """Atomic write (utils/fileio.py, the tuning-cache discipline).
         ``*.jsonl`` paths stream one record per line — header,
         iterations, summary — so megarun reports stay grep/tail-able;
         anything else is one JSON document."""
-        d = os.path.dirname(self.path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as fh:
+        from ..utils.fileio import atomic_write
+        with atomic_write(self.path) as fh:
             if self.path.endswith(".jsonl"):
                 head = {k: report[k] for k in
                         ("schema", "version", "created_unix", "meta")}
@@ -299,7 +309,6 @@ class RunRecorder:
                 fh.write(json.dumps(summary) + "\n")
             else:
                 json.dump(report, fh, indent=1)
-        os.replace(tmp, self.path)
 
 
 def load_run_report(path: str) -> dict:

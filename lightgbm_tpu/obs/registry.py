@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "Timer", "MetricsRegistry",
     "default_registry", "counter", "gauge", "histogram", "timer",
+    "log_buckets", "latency_histogram", "LATENCY_BUCKETS_S",
 ]
 
 
@@ -75,6 +76,26 @@ class Gauge:
 DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 25.0, 60.0)
+
+
+def log_buckets(lo: float, hi: float,
+                per_decade: int = 12) -> Tuple[float, ...]:
+    """Geometric bucket bounds from ``lo`` to (at least) ``hi`` with
+    ``per_decade`` buckets per factor of 10. At 12/decade adjacent
+    bounds differ by ~21%, so an interpolated quantile (see
+    ``Histogram.percentile``) lands within a fifth of the true value
+    across seven decades with under a hundred buckets — the
+    latency-quantile resolution/size trade."""
+    import math
+    lo = float(lo)
+    per_decade = max(int(per_decade), 1)
+    n = int(math.ceil(math.log10(float(hi) / lo) * per_decade))
+    return tuple(lo * 10.0 ** (k / per_decade) for k in range(n + 1))
+
+
+# latency preset: 1 µs .. 60 s — wide enough for a single predict
+# dispatch at the bottom and a cold-compile window wall at the top
+LATENCY_BUCKETS_S: Tuple[float, ...] = log_buckets(1e-6, 60.0, 12)
 
 
 class Histogram:
@@ -125,18 +146,33 @@ class Histogram:
             return self._sum
 
     def percentile(self, q: float) -> Optional[float]:
-        """Upper bound of the bucket holding the q-quantile rank
-        (0 < q <= 1); None when empty."""
+        """q-quantile (0 < q <= 1) with linear interpolation INSIDE the
+        bucket holding the quantile rank: the rank's fractional position
+        among the bucket's samples maps onto the bucket's [lower, upper)
+        bound span — the Prometheus ``histogram_quantile`` estimator.
+        Bounds are clamped to the observed min/max (the first bucket's
+        lower edge is the observed min, the overflow bucket's upper edge
+        the observed max), so a bucket holding one sample still reports
+        a value inside the data range. None when empty."""
         with self._lock:
             if not self._count:
                 return None
             rank = max(1, int(q * self._count + 0.999999))
             cum = 0
             for i, c in enumerate(self._counts):
+                if not c:
+                    continue
                 cum += c
-                if cum >= rank:
-                    return (self.buckets[i] if i < len(self.buckets)
-                            else self._max)
+                if cum < rank:
+                    continue
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else self._max)
+                # clamp to observed range (min/max are exact)
+                lo = max(lo, self._min)
+                hi = max(min(hi, self._max), lo)
+                frac = (rank - (cum - c)) / c
+                return lo + (hi - lo) * frac
             return self._max
 
     def snapshot(self) -> dict:
@@ -147,9 +183,15 @@ class Histogram:
                    "buckets": {str(b): c for b, c in
                                zip(self.buckets, counts) if c},
                    "overflow": counts[-1]}
-        for q, name in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        for q, name in ((0.5, "p50"), (0.9, "p90"), (0.95, "p95"),
+                        (0.99, "p99")):
             out[name] = self.percentile(q)
         return out
+
+    def quantiles(self, qs=(0.5, 0.95, 0.99)) -> dict:
+        """{"p50": v, ...} readout for result tables (bench.py predict
+        latency, lrb.py window wall); values None when empty."""
+        return {f"p{round(q * 100):d}": self.percentile(q) for q in qs}
 
 
 class Timer:
@@ -297,3 +339,13 @@ def histogram(name: str,
 
 def timer(name: str) -> Timer:
     return _default.timer(name)
+
+
+def latency_histogram(name: str,
+                      registry: Optional[MetricsRegistry] = None
+                      ) -> Histogram:
+    """Get-or-create a log-bucketed latency instrument (1 µs – 60 s,
+    12 buckets/decade) — the quantile-grade preset behind
+    ``predict/latency_s`` (bench.py) and ``lrb/window_wall_s``
+    (lrb.py); serving PRs report p50/p95/p99 from these."""
+    return (registry or _default).histogram(name, LATENCY_BUCKETS_S)

@@ -266,13 +266,11 @@ class TuningCache:
     def put(self, key: str, record: dict) -> None:
         entries = self._load()
         entries[key] = record
-        tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
-            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-            with open(tmp, "w") as fh:
+            from ..utils.fileio import atomic_write
+            with atomic_write(self.path) as fh:
                 json.dump({"version": TUNING_CACHE_VERSION,
                            "entries": entries}, fh, indent=1)
-            os.replace(tmp, self.path)
         except OSError as e:
             log.warning("could not persist tuning cache %s: %s",
                         self.path, e)

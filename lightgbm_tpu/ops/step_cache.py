@@ -58,6 +58,7 @@ from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 from ..obs import registry as obs
+from ..obs import trace
 from ..utils import log
 
 # bounded registry: one entry per distinct training geometry; an LRU
@@ -169,8 +170,10 @@ def get_step(key: tuple, builder: Callable[[], Callable]) -> Callable:
         if fn is not None:
             _steps.move_to_end(key)
             obs.counter("step_cache/hits").add(1)
+            trace.instant("step_cache/hit", cat="cache")
             return fn
     obs.counter("step_cache/misses").add(1)
+    trace.instant("step_cache/miss", cat="cache")
     fn = _instrument(builder())
     with _lock:
         # lost race: another thread built it first — keep theirs
@@ -196,7 +199,8 @@ def _instrument(fn: Callable) -> Callable:
         if state["first"]:
             state["first"] = False
             t0 = time.monotonic()
-            out = fn(*args)
+            with trace.span("step_cache/compile", cat="cache"):
+                out = fn(*args)
             dt = time.monotonic() - t0
             obs.timer("step_cache/compile").add(dt)
             log.debug("step cache: compiled a new fused step in %.2fs",
@@ -238,17 +242,26 @@ def build_train_step(*, grower, K: int, n_score: int, n_total: int,
                      grad_fn: Optional[Callable],
                      renew_alpha: Optional[float],
                      sample_hook: Optional[Callable]) -> Callable:
-    """ONE jitted function for a full boosting iteration, pure in its
-    geometry: every data-dependent array (bins, scores, masks, labels
-    via ``aux``, feature metadata via ``meta``, the row-validity mask
-    ``rvalid``) is a traced argument, so the compiled program is
-    shared by every booster with the same geometry key.
+    """ONE jitted function for a full boosting iteration — the SINGLE
+    step implementation (gradient -> K tree builds -> renew ->
+    shrinkage -> score updates -> AddBias on the stored record) behind
+    BOTH routing modes:
 
-    Mirrors GBDT._get_step_fn's legacy closure step exactly (gradient
-    -> K tree builds -> renew -> shrinkage -> score updates -> AddBias
-    on the stored record); the only additions are the ``rvalid`` mask
-    (pad rows' g/h forced to exact +0.0, reproducing the legacy static
-    zero-pad bit-for-bit) and the explicit meta/aux arguments.
+    - **registry path** (GBDT._get_cached_step): pure in its geometry —
+      every data-dependent array (bins, scores, masks, labels via
+      ``aux``, feature metadata via ``meta``, the row-validity mask
+      ``rvalid``) is a traced argument, so the compiled program is
+      shared by every booster with the same geometry key;
+    - **legacy per-booster closure** (GBDT._get_step_fn for
+      cache-ineligible configurations — GOSS's positional sampler,
+      EFB bundles, feature/voting learners, tpu_step_cache=0): the
+      caller passes ``rvalid=None`` (exact row shapes, no validity
+      mask) and ``meta=None`` (the grower consumes its own closure
+      metadata), and the jitted step stays per-instance.
+
+    One body, two callers: the stepcache parity suite
+    (tests/test_step_cache.py) locks them together by construction
+    instead of by a 60-line mirror.
     """
     import jax
     import jax.numpy as jnp
@@ -269,12 +282,16 @@ def build_train_step(*, grower, K: int, n_score: int, n_total: int,
                                    aux["obj"])
             if K == 1:
                 g_all, h_all = g_all[None, :], h_all[None, :]
-        # pad rows: exact +0.0 g/h (a multiply by the zero mask would
-        # produce -0.0 for negative gradients, perturbing the integer
-        # bit-sum salt of the quantized stochastic-rounding stream)
-        g_all = jnp.where(rvalid[None, :], g_all, 0.0)
-        h_all = jnp.where(rvalid[None, :], h_all, 0.0)
+        if rvalid is not None:
+            # pad rows: exact +0.0 g/h (a multiply by the zero mask
+            # would produce -0.0 for negative gradients, perturbing the
+            # integer bit-sum salt of the quantized stochastic-rounding
+            # stream)
+            g_all = jnp.where(rvalid[None, :], g_all, 0.0)
+            h_all = jnp.where(rvalid[None, :], h_all, 0.0)
         if sample_hook is not None:
+            # in-jit gradient-based sampling (GOSS): may amplify g/h
+            # and shrink the bagging mask, all device-side
             g_all, h_all, mask = sample_hook(g_all, h_all, mask, key)
         recs = []
         vs = list(valid_scores)
@@ -284,12 +301,19 @@ def build_train_step(*, grower, K: int, n_score: int, n_total: int,
                 z = jnp.zeros(pad_tail, jnp.float32)
                 g_k = jnp.concatenate([g_k, z])
                 h_k = jnp.concatenate([h_k, z])
-            rec, leaf_full = grower(bins, g_k, h_k, mask, fmask, meta)
+            if meta is None:
+                rec, leaf_full = grower(bins, g_k, h_k, mask, fmask)
+            else:
+                rec, leaf_full = grower(bins, g_k, h_k, mask, fmask,
+                                        meta)
             leaf_ids = leaf_full[:n_score]
             if renew:
-                # objective-driven leaf refit against the PRE-update
-                # scores; pad rows carry zero weight through ``mask``
-                # and cannot shift the percentiles
+                # objective-driven leaf refit
+                # (serial_tree_learner.cpp:780-818) against the
+                # PRE-update scores; splitless trees stay all-zero (the
+                # reference never renews a tree it is about to discard,
+                # gbdt.cpp:393-409); bucket-pad rows carry zero weight
+                # through ``mask`` and cannot shift the percentiles
                 residual = aux["renew"]["label"] - scores[k]
                 new_out = renew_leaf_outputs(
                     leaf_ids, residual, aux["renew"].get("w"),
@@ -298,15 +322,24 @@ def build_train_step(*, grower, K: int, n_score: int, n_total: int,
                 new_out = jnp.where(rec.num_leaves > 1, new_out,
                                     rec.leaf_output)
                 rec = rec._replace(leaf_output=new_out)
+            # fold shrinkage (Tree::Shrinkage, gbdt.cpp:371)
             rec = rec._replace(
                 leaf_output=rec.leaf_output * shrink,
                 internal_value=rec.internal_value * shrink)
+            # out-of-bag rows included: the partition covers ALL rows
             scores = scores.at[k].set(add_leaf_outputs(
                 scores[k], leaf_ids, rec.leaf_output, 1.0))
             for vi, (voff, vn) in enumerate(valid_slices):
                 vleaf = leaf_full[voff:voff + vn]
                 vs[vi] = vs[vi].at[k].set(add_leaf_outputs(
                     vs[vi][k], vleaf, rec.leaf_output, 1.0))
+            # AddBias on the STORED record only (tree.h:151): the init
+            # score already reached train/valid scores through
+            # BoostFromAverage's AddScore, so the score updates above
+            # use the un-biased outputs. For a splitless first tree
+            # this also yields the reference's constant tree
+            # (leaf0 = init, gbdt.cpp:378-396); biasing unused leaf
+            # slots is harmless (leaf_ids never reference them).
             rec = rec._replace(
                 leaf_output=rec.leaf_output + init_bias[k],
                 internal_value=rec.internal_value + init_bias[k])

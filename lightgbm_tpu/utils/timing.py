@@ -15,6 +15,10 @@ attribution ``.watch(out)`` their output (sync at phase exit).
 When profiling is active (obs/profiler.py ProfileWindow), each phase
 additionally wraps its block in a ``jax.profiler.TraceAnnotation`` so
 the engine's phase names show up as spans in XLA/Perfetto traces.
+When the engine's own tracer is active (obs/trace.py, config
+``tpu_trace``), each phase also records a span on the calling thread's
+trace row — one file shows the ingest worker's phases interleaved with
+the main thread's.
 """
 from __future__ import annotations
 
@@ -22,6 +26,7 @@ import time
 from contextlib import contextmanager
 
 from ..obs import registry as _obs
+from ..obs import trace as _trace
 from . import log
 
 # emit jax TraceAnnotations around phases (toggled by the profiler
@@ -69,6 +74,8 @@ def phase(name: str):
             ann.__enter__()
         except Exception:               # noqa: BLE001 — annotation is
             ann = None                  # an aid, never a failure mode
+    tracer = _trace.active()
+    span_t0 = tracer.now_us() if tracer is not None else 0.0
     t0 = time.monotonic()
     h = _PhaseHandle()
     try:
@@ -82,6 +89,11 @@ def phase(name: str):
             except Exception:           # noqa: BLE001
                 pass
         _obs.timer(name).add(time.monotonic() - t0)
+        if tracer is not None:
+            # same block, same clock stop: every phase is also a span
+            # in the cross-thread trace (obs/trace.py) — the ingest
+            # worker's phases land on their own tid row
+            tracer.complete(name, "phase", span_t0)
 
 
 def add(name: str, seconds: float) -> None:

@@ -1,0 +1,154 @@
+"""Bench-regression gate (tools/check_bench_regression.py): artifact
+normalization (raw bench JSON + BENCH_r0x wrappers, tail-AUC
+recovery), schema validation, trajectory comparison semantics, and a
+slow-marked end-to-end run of ``bench.py --quick`` through the tool.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_bench_regression as cbr  # noqa: E402
+
+pytestmark = pytest.mark.obs
+
+
+def _fresh(metric="M", value=50.0, test_auc=0.927, **kw):
+    d = {"metric": metric, "value": value, "unit": "M row-iters/s",
+         "test_auc": test_auc}
+    d.update(kw)
+    return d
+
+
+# -- normalization -----------------------------------------------------------
+
+def test_load_bench_raw_and_wrapper(tmp_path):
+    raw = _fresh()
+    p = tmp_path / "fresh.json"
+    p.write_text(json.dumps(raw))
+    assert cbr.load_bench(str(p))["value"] == 50.0
+    # BENCH_r0x wrapper: numbers under "parsed", AUC only in the tail
+    wrapper = {"rc": 0,
+               "tail": "# 500 iters in 112.1s  train-AUC=0.93202  "
+                       "test-AUC=0.92726  (holdout...)",
+               "parsed": {"metric": "M", "value": 48.954,
+                          "unit": "M row-iters/s"}}
+    norm = cbr.load_bench(wrapper)
+    assert norm["value"] == 48.954
+    assert norm["test_auc"] == pytest.approx(0.92726)
+    assert norm["train_auc"] == pytest.approx(0.93202)
+
+
+def test_trajectory_orders_numerically(tmp_path):
+    """r10 must sort AFTER r9 (lexicographic order would pin the gate
+    to a stale baseline once the run index grows a digit)."""
+    for name in ("BENCH_r9.json", "BENCH_r10.json", "BENCH_r2.json"):
+        (tmp_path / name).write_text("{}")
+    names = [os.path.basename(p) for p in cbr.trajectory(str(tmp_path))]
+    assert names == ["BENCH_r2.json", "BENCH_r9.json", "BENCH_r10.json"]
+
+
+def test_repo_trajectory_loads_and_self_passes():
+    """The repo's own BENCH_r0x files normalize, and the latest point
+    compared against itself passes (the tool's identity check)."""
+    points = cbr.trajectory(REPO)
+    assert len(points) >= 2, "BENCH_r0x trajectory missing from repo"
+    latest = cbr.load_bench(points[-1])
+    assert not cbr.check_schema(latest)
+    assert latest.get("test_auc") is not None, \
+        "tail AUC recovery failed on the real trajectory"
+    assert cbr.compare(latest, latest) == []
+
+
+# -- schema ------------------------------------------------------------------
+
+def test_check_schema():
+    assert cbr.check_schema(_fresh()) == []
+    assert cbr.check_schema({"unit": "rows"})   # several problems
+    bad_lat = _fresh(predict_latency={"p50_ms": 1.0, "p95_ms": None,
+                                      "p99_ms": 2.0})
+    assert any("p95" in p for p in cbr.check_schema(bad_lat))
+    good_lat = _fresh(predict_latency={"p50_ms": 1.0, "p95_ms": 2.0,
+                                       "p99_ms": 3.0})
+    assert cbr.check_schema(good_lat) == []
+    # malformed artifact must be REPORTED, not crash the validator
+    assert any("not a dict" in p for p in
+               cbr.check_schema(_fresh(predict_latency="n/a")))
+
+
+# -- comparison semantics ----------------------------------------------------
+
+def test_compare_throughput_and_auc():
+    base = _fresh(value=49.0, test_auc=0.927)
+    assert cbr.compare(_fresh(value=45.0, test_auc=0.9275), base) == []
+    # throughput: 20% tolerance boundary
+    probs = cbr.compare(_fresh(value=35.0), base)
+    assert probs and "throughput regression" in probs[0]
+    assert cbr.compare(_fresh(value=39.3), base) == []
+    # quality: absolute AUC drop beyond tolerance
+    probs = cbr.compare(_fresh(test_auc=0.920), base)
+    assert probs and "quality regression" in probs[0]
+    # a fresh run that LOST the AUC field cannot silently pass
+    fresh = _fresh()
+    del fresh["test_auc"]
+    assert any("no test_auc" in p for p in cbr.compare(fresh, base))
+
+
+def test_compare_refuses_cross_workload():
+    base = _fresh(metric="HIGGS 11000000 rows")
+    probs = cbr.compare(_fresh(metric="quick 65536 rows", value=1.0),
+                        base)
+    assert len(probs) == 1 and "not comparable" in probs[0]
+
+
+def test_cli_pass_fail_and_exit_codes(tmp_path):
+    base_dir = tmp_path / "repo"
+    base_dir.mkdir()
+    (base_dir / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": _fresh(value=49.0)}))
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_fresh(value=48.0)))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_fresh(value=10.0)))
+    garbled = tmp_path / "garbled.json"
+    garbled.write_text(json.dumps({"unit": "bananas"}))
+    assert cbr.main([str(ok), "--baseline-dir", str(base_dir)]) == 0
+    assert cbr.main([str(bad), "--baseline-dir", str(base_dir)]) == 1
+    assert cbr.main([str(garbled), "--baseline-dir",
+                     str(base_dir)]) == 2
+    assert cbr.main([str(bad), "--schema-only"]) == 0
+
+
+# -- end-to-end (slow): a real quick bench through the gate ------------------
+
+@pytest.mark.slow
+def test_quick_bench_json_schema_end_to_end(tmp_path):
+    """``bench.py --quick`` emits a JSON line whose predict-latency
+    p50/p95/p99 come from the log-bucketed histogram, and the gate's
+    schema check accepts it (a quick run is NOT comparable to the
+    full-size trajectory — that is exactly what --schema-only is
+    for)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    doc = json.loads(line)
+    lat = doc["predict_latency"]
+    assert lat["batches"] > 10
+    for q in ("p50_ms", "p95_ms", "p99_ms"):
+        assert lat[q] > 0
+    assert lat["p50_ms"] <= lat["p95_ms"] <= lat["p99_ms"]
+    assert 0.5 < doc["test_auc"] <= 1.0
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(line)
+    assert cbr.main([str(fresh), "--schema-only"]) == 0
+    # and the full-size gate refuses the shape mismatch instead of
+    # comparing apples to oranges
+    assert cbr.main([str(fresh), "--baseline-dir", REPO]) == 2

@@ -538,6 +538,347 @@ def test_phase_blocks_register_watch():
         f"(device time will be misattributed): {offenders}")
 
 
+# -- log-bucketed latency quantiles ------------------------------------------
+
+def test_log_buckets_shape():
+    from lightgbm_tpu.obs.registry import LATENCY_BUCKETS_S, log_buckets
+    b = log_buckets(1e-3, 1.0, per_decade=10)
+    assert b[0] == pytest.approx(1e-3)
+    assert b[-1] >= 1.0
+    assert len(b) == 31                     # 3 decades x 10 + 1
+    ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+    assert all(r == pytest.approx(10 ** 0.1, rel=1e-9) for r in ratios)
+    # the preset spans predict-dispatch to window-wall magnitudes
+    assert LATENCY_BUCKETS_S[0] == pytest.approx(1e-6)
+    assert LATENCY_BUCKETS_S[-1] >= 60.0
+
+
+def test_quantiles_vs_numpy_percentile():
+    """Interpolated histogram quantiles track numpy.percentile within
+    one log-bucket's resolution on a realistic latency mixture."""
+    import numpy as np
+
+    from lightgbm_tpu.obs.registry import (MetricsRegistry,
+                                           latency_histogram)
+    rng = np.random.default_rng(3)
+    # bimodal: fast path ~2ms + slow tail ~80ms (the serving shape)
+    fast = rng.lognormal(np.log(2e-3), 0.25, size=4000)
+    slow = rng.lognormal(np.log(8e-2), 0.3, size=250)
+    samples = np.concatenate([fast, slow])
+    reg = MetricsRegistry()
+    h = latency_histogram("lat", reg)
+    for v in samples:
+        h.observe(float(v))
+    bucket_ratio = 10 ** (1 / 12)           # adjacent bound spacing
+    for q in (0.5, 0.9, 0.95, 0.99):
+        est = h.percentile(q)
+        ref = float(np.percentile(samples, 100 * q))
+        assert ref / bucket_ratio <= est <= ref * bucket_ratio, \
+            f"q={q}: est {est:g} vs numpy {ref:g}"
+    # interpolation stays inside the observed range
+    assert h.percentile(1.0) == pytest.approx(samples.max())
+    snap = h.snapshot()
+    assert snap["p95"] is not None and snap["p50"] < snap["p95"]
+
+
+def test_quantiles_exact_degenerate_cases():
+    from lightgbm_tpu.obs.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    h = reg.histogram("one", buckets=(1.0, 2.0))
+    h.observe(1.5)
+    # a single sample reports itself regardless of bucket width
+    assert h.percentile(0.5) == pytest.approx(1.5)
+    const = reg.histogram("const", buckets=(1.0, 2.0))
+    for _ in range(100):
+        const.observe(1.5)
+    for q in (0.01, 0.5, 0.99):
+        assert const.percentile(q) == pytest.approx(1.5)
+    assert reg.histogram("one").quantiles() == {
+        "p50": pytest.approx(1.5), "p95": pytest.approx(1.5),
+        "p99": pytest.approx(1.5)}
+
+
+# -- live metrics exporter ---------------------------------------------------
+
+def _prom_lines_ok(text):
+    """Every non-comment line is `name{labels} value` with a legal
+    Prometheus metric name."""
+    pat = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? \S+$")
+    lines = [ln for ln in text.splitlines() if ln and
+             not ln.startswith("#")]
+    assert lines, "no samples rendered"
+    for ln in lines:
+        assert pat.match(ln), f"bad exposition line: {ln!r}"
+    return lines
+
+
+def test_prometheus_text_rendering():
+    from lightgbm_tpu.obs.export import prometheus_text
+    from lightgbm_tpu.obs.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.counter("ingest/h2d_bytes").add(1234)
+    reg.gauge("device/hbm_bytes_in_use").set(5e8)
+    reg.timer("train/step_dispatch").add(0.25)
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = prometheus_text(reg.snapshot())
+    lines = _prom_lines_ok(text)
+    joined = "\n".join(lines)
+    assert "lgbm_tpu_ingest_h2d_bytes_total 1234" in joined
+    assert "lgbm_tpu_device_hbm_bytes_in_use 500000000" in joined
+    assert "lgbm_tpu_train_step_dispatch_seconds_total 0.25" in joined
+    assert "lgbm_tpu_train_step_dispatch_calls_total 1" in joined
+    # histogram: cumulative buckets + +Inf == count
+    assert 'lgbm_tpu_lat_bucket{le="0.1"} 1' in joined
+    assert 'lgbm_tpu_lat_bucket{le="1"} 2' in joined
+    assert 'lgbm_tpu_lat_bucket{le="+Inf"} 3' in joined
+    assert "lgbm_tpu_lat_count 3" in joined
+
+
+def test_exporter_writes_during_run(tmp_path):
+    """The exporter snapshots the registry DURING a run: .prom is
+    replaced and .jsonl appended on the interval while counters are
+    still moving — not a finish-time artifact."""
+    import time as _t
+
+    from lightgbm_tpu.obs.export import MetricsExporter
+    from lightgbm_tpu.obs.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    base = str(tmp_path / "live")
+    ex = MetricsExporter(base_path=base, interval_s=0.05,
+                         registry=reg).start()
+    try:
+        deadline = _t.monotonic() + 5.0
+        while ex.snapshots_written < 3 and _t.monotonic() < deadline:
+            reg.counter("work/items").add(1)
+            _t.sleep(0.01)
+        assert ex.snapshots_written >= 3
+        # files exist and parse WHILE the run is still going
+        text = open(ex.prom_path).read()
+        _prom_lines_ok(text)
+        assert "lgbm_tpu_work_items_total" in text
+        rows = [json.loads(ln) for ln in open(ex.jsonl_path)]
+        assert len(rows) >= 2
+        assert rows[0]["ts"] <= rows[-1]["ts"]
+        assert rows[-1]["counters"]["work/items"] >= 1
+        # time series is append-only: later rows never lose counts
+        counts = [r["counters"].get("work/items", 0) for r in rows]
+        assert counts == sorted(counts)
+    finally:
+        ex.stop()
+    # suffix stripping: pointing the knob at the .jsonl works too
+    ex2 = MetricsExporter(base_path=base + ".jsonl", interval_s=5,
+                          registry=reg)
+    assert ex2.base_path == base
+
+
+def test_exporter_http_endpoint(tmp_path):
+    """GET /metrics over the stdlib server scrapes a live registry;
+    /metrics.json returns the raw snapshot; others 404."""
+    import urllib.error
+    import urllib.request
+
+    from lightgbm_tpu.obs.export import MetricsExporter
+    from lightgbm_tpu.obs.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    reg.counter("serve/requests").add(7)
+    ex = MetricsExporter(base_path=str(tmp_path / "m"), interval_s=60,
+                         port=0, registry=reg).start()
+    try:
+        port = ex.http_port
+        assert port
+        url = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            assert r.status == 200
+            body = r.read().decode()
+        _prom_lines_ok(body)
+        assert "lgbm_tpu_serve_requests_total 7" in body
+        with urllib.request.urlopen(f"{url}/metrics.json",
+                                    timeout=10) as r:
+            snap = json.loads(r.read())
+        assert snap["counters"]["serve/requests"] == 7
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{url}/other", timeout=10)
+    finally:
+        ex.stop()
+    # the port is released after stop
+    assert ex.http_port is None
+
+
+def test_exporter_ensure_from_config_and_shutdown(tmp_path):
+    from lightgbm_tpu.obs import export as obs_export
+    obs_export.shutdown()
+    try:
+        assert obs_export.ensure_from_config({}) is None
+        ex = obs_export.ensure_from_config(
+            {"tpu_metrics_export": str(tmp_path / "g"),
+             "tpu_metrics_interval_s": "30"})
+        assert ex is not None and ex.interval_s == 30.0
+        # later boosters JOIN the running exporter
+        assert obs_export.ensure_from_config(
+            {"tpu_metrics_export": str(tmp_path / "g")}) is ex
+        assert os.path.exists(ex.prom_path)   # immediate first snapshot
+    finally:
+        obs_export.shutdown()
+    assert obs_export.global_exporter() is None
+
+
+def test_exporter_survives_port_in_use(tmp_path):
+    """A taken (or bogus) HTTP port degrades to file-only export with
+    a warning — never an exception out of GBDT init."""
+    from lightgbm_tpu.obs.export import MetricsExporter
+    from lightgbm_tpu.obs.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    ex1 = MetricsExporter(base_path=str(tmp_path / "a"), interval_s=60,
+                          port=0, registry=reg).start()
+    lines = []
+    log.set_callback(lines.append)
+    try:
+        ex2 = MetricsExporter(base_path=str(tmp_path / "b"),
+                              interval_s=60, port=ex1.http_port,
+                              registry=reg).start()
+        assert ex2.http_port is None        # no server, no crash
+        assert os.path.exists(ex2.prom_path)  # files still flow
+        ex2.stop()
+        ex3 = MetricsExporter(base_path=str(tmp_path / "c"),
+                              interval_s=60, port=70000,
+                              registry=reg).start()
+        assert ex3.http_port is None
+        ex3.stop()
+    finally:
+        log.set_callback(None)
+        ex1.stop()
+    assert sum("metrics HTTP endpoint" in ln for ln in lines) == 2
+
+
+def test_exporter_unwritable_path_warns_once(tmp_path):
+    """An unwritable export destination logs ONE diagnostic and keeps
+    the run alive (snapshots keep silently retrying)."""
+    from lightgbm_tpu.obs.export import MetricsExporter
+    from lightgbm_tpu.obs.registry import MetricsRegistry
+    bad = str(tmp_path / "f")
+    (tmp_path / "f").write_text("")         # file where a DIR is needed
+    lines = []
+    log.set_callback(lines.append)
+    try:
+        ex = MetricsExporter(base_path=bad + "/sub/base",
+                             interval_s=60,
+                             registry=MetricsRegistry()).start()
+        ex._write_once()                     # second failure: no spam
+        ex.stop(final_snapshot=True)         # third: still quiet
+    finally:
+        log.set_callback(None)
+    assert sum("metrics export" in ln and "failing" in ln
+               for ln in lines) == 1
+
+
+def test_exporter_config_mismatch_warns(tmp_path):
+    from lightgbm_tpu.obs import export as obs_export
+    obs_export.shutdown()
+    lines = []
+    log.set_callback(lines.append)
+    try:
+        ex = obs_export.ensure_from_config(
+            {"tpu_metrics_export": str(tmp_path / "a")})
+        assert obs_export.ensure_from_config(
+            {"tpu_metrics_export": str(tmp_path / "b")}) is ex
+    finally:
+        log.set_callback(None)
+        obs_export.shutdown()
+    assert any("ignored for this process" in ln for ln in lines)
+
+
+def test_lrb_window_wall_quantiles_per_driver():
+    """A second driver's quantile summary must not inherit an earlier
+    run's windows (the process-global instrument stays cumulative for
+    the exporter; the summary is per-run)."""
+    import io
+
+    from lightgbm_tpu.lrb import LrbDriver
+    d1 = LrbDriver(cache_size=1 << 16, window_size=256,
+                   sample_size=128, cutoff=0.5, sampling=1,
+                   result_file=io.StringIO())
+    d1._wall_hist.observe(42.0)             # stand-in for a slow run
+    assert d1.window_wall_quantiles()["p99"] == pytest.approx(42.0)
+    d2 = LrbDriver(cache_size=1 << 16, window_size=256,
+                   sample_size=128, cutoff=0.5, sampling=1,
+                   result_file=io.StringIO())
+    assert d2.window_wall_quantiles() is None
+
+
+def test_training_run_feeds_live_exporter(tmp_path):
+    """The config-wired path: a training run with tpu_metrics_export
+    set starts the process-global exporter from GBDT.init and registry
+    snapshots land on disk while the run proceeds."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.obs import export as obs_export
+    from lightgbm_tpu.objectives import create_objective
+
+    obs_export.shutdown()
+    try:
+        X, y = make_regression(n=640)
+        cfg = Config().set({**TEST_PARAMS, "objective": "regression",
+                            "num_iterations": 3,
+                            "tpu_metrics_export": str(tmp_path / "live"),
+                            "tpu_metrics_interval_s": 0.05})
+        ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+        obj = create_objective("regression", cfg)
+        obj.init(ds.metadata, ds.num_data)
+        g = GBDT()
+        g.init(cfg, ds, obj, ())
+        ex = obs_export.global_exporter()
+        assert ex is not None, "GBDT.init did not start the exporter"
+        g.train()
+        assert ex.snapshots_written >= 1
+        rows = [json.loads(ln) for ln in open(ex.jsonl_path)]
+        assert rows and rows[-1]["counters"]
+        _prom_lines_ok(open(ex.prom_path).read())
+    finally:
+        obs_export.shutdown()
+
+
+# -- report <-> trace cross-link ---------------------------------------------
+
+def test_run_report_meta_gains_trace_path(tmp_path):
+    """A training run with BOTH tpu_run_report and tpu_trace set
+    cross-links them: the report's meta carries trace_path and the
+    trace file exists with iteration spans by the time finish()
+    returns."""
+    from lightgbm_tpu.obs import trace
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    report_path = str(tmp_path / "run.json")
+    trace_path = str(tmp_path / "run_trace.json")
+    trace.stop()
+    try:
+        X, y = make_regression(n=640)
+        cfg = Config().set({**TEST_PARAMS, "objective": "regression",
+                            "num_iterations": 3,
+                            "tpu_run_report": report_path,
+                            "tpu_trace": trace_path})
+        ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+        obj = create_objective("regression", cfg)
+        obj.init(ds.metadata, ds.num_data)
+        g = GBDT()
+        g.init(cfg, ds, obj, ())
+        g.train()
+        rep = load_run_report(report_path)
+        assert rep["meta"]["trace_path"] == trace_path
+        doc = json.load(open(trace_path))
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "X"}
+        assert "iteration" in names
+        assert "train/step_dispatch" in names
+    finally:
+        trace.stop()
+
+
 def test_obs_marker_registered():
     """`pytest -m obs` must select this suite: the marker is declared
     in pyproject (unknown markers would warn and select nothing)."""

@@ -1,0 +1,186 @@
+"""Bench-regression gate: compare a fresh bench JSON against the
+repo's BENCH_r0x trajectory.
+
+The perf ledger lives in-repo as BENCH_r01..r0N snapshots (each the
+driver's wrapper around one ``python bench.py`` run); until now a
+throughput or quality regression only surfaced when a reviewer eyeballed
+the numbers. This tool makes the comparison mechanical:
+
+- **throughput**: the fresh run's ``value`` (M row-iters/s) must be
+  within ``--throughput-tol`` (default 20%, measurement noise on shared
+  hosts) of the LATEST trajectory point;
+- **quality**: the fresh run's test AUC must be no more than
+  ``--auc-tol`` (default 2e-3) below the latest baseline's (parsed from
+  the wrapper's stderr tail when the JSON predates the in-line field);
+- **comparability**: the bench ``metric`` string embeds the workload
+  shape (rows x features, leaves, bins, iters, chips) — a quick run is
+  refused against a full-size baseline instead of "passing" a
+  meaningless comparison (``--schema-only`` skips the trajectory and
+  just validates the fresh artifact's shape, including the
+  predict-latency quantiles).
+
+Standalone:  ``python tools/check_bench_regression.py fresh.json``
+(exit 0 pass / 1 regression / 2 schema-or-usage error); also importable
+— tests/test_bench_regression.py drives ``compare``/``check_schema``
+directly and a slow-marked test runs the real ``bench.py --quick``
+through ``--schema-only``.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+DEFAULT_THROUGHPUT_TOL = 0.20
+DEFAULT_AUC_TOL = 2e-3
+
+# the wrapper's stderr tail carries the AUC line for trajectory points
+# that predate the in-JSON train_auc/test_auc fields
+_TAIL_AUC_RE = re.compile(
+    r"train-AUC=(?P<train>[0-9.]+)\s+test-AUC=(?P<test>[0-9.]+)")
+
+
+def load_bench(doc) -> dict:
+    """Normalize a bench artifact — either the raw JSON line bench.py
+    prints, or a BENCH_r0x wrapper ({"parsed": ..., "tail": ...}) — to
+    one flat dict with metric/value/unit and (when recoverable)
+    train_auc/test_auc."""
+    if isinstance(doc, str):
+        with open(doc) as fh:
+            doc = json.load(fh)
+    out = dict(doc.get("parsed") or doc)
+    tail = doc.get("tail", "")
+    if tail and ("test_auc" not in out or out.get("test_auc") is None):
+        m = _TAIL_AUC_RE.search(tail)
+        if m:
+            out.setdefault("train_auc", float(m.group("train")))
+            out["test_auc"] = float(m.group("test"))
+    return out
+
+
+def trajectory(baseline_dir: str) -> List[str]:
+    """BENCH_r*.json paths in trajectory order — NUMERIC run index
+    (lexicographic order would park r100 before r11 forever)."""
+
+    def run_index(path):
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        return (int(m.group(1)) if m else 0, path)
+
+    return sorted(glob.glob(os.path.join(baseline_dir, "BENCH_r*.json")),
+                  key=run_index)
+
+
+def check_schema(fresh: dict) -> List[str]:
+    """Shape problems in a (normalized) fresh bench artifact."""
+    problems = []
+    if not isinstance(fresh.get("value"), (int, float)):
+        problems.append("missing numeric 'value' (M row-iters/s)")
+    if fresh.get("unit") != "M row-iters/s":
+        problems.append(f"unexpected unit {fresh.get('unit')!r}")
+    if not isinstance(fresh.get("metric"), str):
+        problems.append("missing 'metric' workload descriptor")
+    lat = fresh.get("predict_latency")
+    if lat is not None:
+        if not isinstance(lat, dict):
+            problems.append(
+                f"predict_latency is {type(lat).__name__}, not a dict")
+        else:
+            for q in ("p50_ms", "p95_ms", "p99_ms"):
+                if not isinstance(lat.get(q), (int, float)):
+                    problems.append(f"predict_latency.{q} missing/null")
+    return problems
+
+
+def compare(fresh: dict, baseline: dict,
+            throughput_tol: float = DEFAULT_THROUGHPUT_TOL,
+            auc_tol: float = DEFAULT_AUC_TOL) -> List[str]:
+    """Regression problems of ``fresh`` vs one ``baseline`` point
+    (both normalized); empty list == pass. Refuses cross-workload
+    comparisons (the metric strings embed the shape)."""
+    if fresh.get("metric") != baseline.get("metric"):
+        return [f"not comparable: workload {fresh.get('metric')!r} "
+                f"vs baseline {baseline.get('metric')!r}"]
+    problems = []
+    bv, fv = baseline.get("value"), fresh.get("value")
+    if isinstance(bv, (int, float)) and isinstance(fv, (int, float)):
+        floor = (1.0 - throughput_tol) * bv
+        if fv < floor:
+            problems.append(
+                f"throughput regression: {fv:g} M row-iters/s < "
+                f"{floor:g} (baseline {bv:g} - {throughput_tol:.0%})")
+    ba, fa = baseline.get("test_auc"), fresh.get("test_auc")
+    if isinstance(ba, (int, float)) and isinstance(fa, (int, float)):
+        if fa < ba - auc_tol:
+            problems.append(
+                f"quality regression: test AUC {fa:.5f} < baseline "
+                f"{ba:.5f} - {auc_tol:g}")
+    elif isinstance(ba, (int, float)):
+        problems.append("fresh run carries no test_auc to compare")
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate a fresh bench JSON against the BENCH_r0x "
+                    "trajectory.")
+    ap.add_argument("fresh", help="fresh bench JSON (bench.py output "
+                                  "line saved to a file, or a BENCH_r0x"
+                                  "-style wrapper)")
+    ap.add_argument("--baseline-dir",
+                    default=os.path.join(os.path.dirname(__file__),
+                                         os.pardir),
+                    help="directory holding BENCH_r*.json (default: "
+                         "repo root)")
+    ap.add_argument("--throughput-tol", type=float,
+                    default=DEFAULT_THROUGHPUT_TOL,
+                    help="allowed fractional throughput drop vs the "
+                         "latest baseline (default 0.20)")
+    ap.add_argument("--auc-tol", type=float, default=DEFAULT_AUC_TOL,
+                    help="allowed absolute test-AUC drop (default 2e-3)")
+    ap.add_argument("--schema-only", action="store_true",
+                    help="validate the fresh artifact's shape only "
+                         "(quick runs are not comparable to the "
+                         "full-size trajectory)")
+    args = ap.parse_args(argv)
+
+    try:
+        fresh = load_bench(args.fresh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"cannot read {args.fresh}: {e}", file=sys.stderr)
+        return 2
+    problems = check_schema(fresh)
+    if problems:
+        for p in problems:
+            print(f"SCHEMA: {p}", file=sys.stderr)
+        return 2
+    if args.schema_only:
+        print(f"schema ok: {args.fresh} "
+              f"({fresh['value']:g} {fresh['unit']})")
+        return 0
+
+    points = trajectory(args.baseline_dir)
+    if not points:
+        print(f"no BENCH_r*.json under {args.baseline_dir}",
+              file=sys.stderr)
+        return 2
+    baseline = load_bench(points[-1])
+    problems = compare(fresh, baseline, args.throughput_tol,
+                       args.auc_tol)
+    if problems:
+        for p in problems:
+            print(f"REGRESSION vs {os.path.basename(points[-1])}: {p}",
+                  file=sys.stderr)
+        return 1 if not problems[0].startswith("not comparable") else 2
+    print(f"pass: {fresh['value']:g} {fresh['unit']} vs "
+          f"{baseline['value']:g} in {os.path.basename(points[-1])} "
+          f"(tol {args.throughput_tol:.0%}), test AUC "
+          f"{fresh.get('test_auc')} vs {baseline.get('test_auc')}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
