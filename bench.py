@@ -78,6 +78,16 @@ def main():
                          "sliding-window pattern) and report warm vs "
                          "cold compile time + step-cache hit rate in "
                          "the JSON output")
+    ap.add_argument("--serve", action="store_true",
+                    help="after training, run the serving-latency "
+                         "bench: p50/p95/p99 per-request latency and "
+                         "sustained rows/s at 1/64/4096-row batches "
+                         "through the geometry-keyed predict registry "
+                         "(ops/predict_cache.py), reported under "
+                         "'serve' in the JSON line")
+    ap.add_argument("--serve-seconds", type=float, default=2.0,
+                    help="measurement budget per serve batch size "
+                         "(default 2.0s, after 2 warmup requests)")
     ap.add_argument("--run-report", default="",
                     help="write the run-report artifact here "
                          "(tpu_run_report; .jsonl for line-delimited). "
@@ -287,7 +297,55 @@ def main():
               f"{compile_s:.1f}s, step-cache hit rate "
               f"{retrain['hit_rate']:.0%}", file=sys.stderr)
 
+    # --serve: the online-inference half of the ledger. Per-request
+    # wall (dispatch + device->host materialize) at serving-shaped
+    # batch sizes, through the SAME public predict entry a model
+    # server would call — micro-batches pad to pow2 serve buckets and
+    # dispatch through the geometry-keyed predict registry, so every
+    # batch size 1..bucket rides one warm compiled program.
+    from lightgbm_tpu.ops import predict_cache
+    serve = None
+    if args.serve:
+        serve = {"batches": {}}
+        pc0 = predict_cache.stats()
+        for b in (1, 64, 4096):
+            hist = obs_registry.latency_histogram(
+                f"serve/latency_s_b{b}")
+            n_test = len(X_test)
+            for _ in range(2):          # warmup: compile + registry
+                g.predict_raw(X_test[:b])
+            reqs = rows = 0
+            t0 = time.time()
+            t_end = t0 + args.serve_seconds
+            while time.time() < t_end:
+                r0 = (reqs * b) % max(n_test - b, 1)
+                tb = time.time()
+                g.predict_raw(X_test[r0:r0 + b])
+                hist.observe(time.time() - tb)
+                reqs += 1
+                rows += b
+            wall = time.time() - t0
+            q = hist.quantiles((0.5, 0.95, 0.99))
+            serve["batches"][str(b)] = {
+                "requests": reqs,
+                "rows_per_s": round(rows / max(wall, 1e-9), 1),
+                **{f"{k}_ms": (None if v is None
+                               else round(1e3 * v, 3))
+                   for k, v in q.items()},
+            }
+            print(f"# serve b={b}: {reqs} reqs, "
+                  f"{serve['batches'][str(b)]['rows_per_s']:.0f} "
+                  "rows/s, "
+                  + " ".join(f"{k}={1e3 * v:.2f}ms"
+                             for k, v in q.items() if v is not None),
+                  file=sys.stderr)
+        pc1 = predict_cache.stats()
+        serve["predict_cache"] = {
+            k: pc1[k] - pc0[k] for k in ("hits", "misses", "stacks",
+                                         "extends")}
+
     recorder.meta["step_cache"] = step_cache.stats()
+    recorder.meta["predict_cache"] = predict_cache.stats()
     report = recorder.finish(
         leaves_per_iteration=leaves or None,
         waves_per_iteration=waves or None,
@@ -306,6 +364,8 @@ def main():
         "chips": g.num_devices,
         "comm_bytes_per_iter": comm_per_iter,
         "step_cache": step_cache.stats(),
+        "predict_cache": predict_cache.stats(),
+        "serve": serve,
         "retrain": retrain,
         "train_auc": round(float(auc), 5),
         "test_auc": round(float(test_auc), 5),

@@ -664,6 +664,9 @@ def LGBM_BoosterSetLeafValue(handle: _BoosterHandle, tree_idx: int,
         leaf_output=rec.leaf_output.at[int(leaf_idx)].set(
             jnp.float32(val)))
     g._scores_stale = True
+    # in-place edit: tree identity survives, so the stacked predictor
+    # must be dropped explicitly (prefix reuse cannot see the change)
+    g._invalidate_stacked()
     return 0
 
 
@@ -686,6 +689,9 @@ def LGBM_BoosterShuffleModels(handle: _BoosterHandle, start: int = 0,
     g.models = [g.models[i] for i in perm]
     g.records = [g.records[i] for i in perm]
     g._tree_shrinkage = [g._tree_shrinkage[i] for i in perm]
+    # the reorder is an ensemble mutation: stale stacked predictors
+    # would keep serving the OLD tree order
+    g._bump_model_gen()
     return 0
 
 

@@ -386,6 +386,27 @@ class Config:
     # aggregates, the stochastic-rounding stream and renew percentiles
     # are bit-identical to the exact-shape run.
     tpu_row_bucket: int = -1
+    # process-wide geometry-keyed predict registry
+    # (ops/predict_cache.py): the stacked predictor's dispatch becomes
+    # a pure function of an explicit geometry key (table offsets,
+    # padded split/leaf axes, classes, tree-chunk/step counts, row
+    # bucket, device kind) held in a bounded LRU — a retrained model
+    # with the same geometry (the sliding-window workload) hits a warm
+    # compiled program instead of re-tracing, and the hit/miss/stack
+    # counters make the reuse observable. -1 = auto (on); 0 = off
+    # (per-model dispatch closures, no counters — jax's own trace
+    # cache still dedupes identical shapes); 1 = on.
+    tpu_predict_cache: int = -1
+    # serving-batch shape buckets (ops/predict_cache.py
+    # serve_bucket_rows): online predict batches pad up to this
+    # policy's width so a live request stream (1..4096-row batches)
+    # touches a handful of compiled programs instead of one per
+    # distinct batch size. Bit-exact: rows are independent in every
+    # predict kernel and pad rows are sliced off. -1 = auto (next
+    # power of two, floor 16; pow2/16 steps above 16k); 0 = exact
+    # shapes (one trace per batch size); N > 0 = round up to a
+    # multiple of N.
+    tpu_serve_bucket: int = -1
     # persistent XLA compile cache on NON-TPU backends (ops/autotune.py
     # ensure_compile_cache): the cache is always wired on TPU, but this
     # image's jax 0.4.x CPU backend flakily segfaults while
@@ -594,6 +615,15 @@ class Config:
             log.warning("tpu_row_bucket=%d is negative; using -1 "
                         "(power-of-two buckets)", self.tpu_row_bucket)
             self.tpu_row_bucket = -1
+        if self.tpu_predict_cache not in (-1, 0, 1):
+            log.warning("tpu_predict_cache=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_predict_cache)
+            self.tpu_predict_cache = -1
+        if self.tpu_serve_bucket < -1:
+            log.warning("tpu_serve_bucket=%d is negative; using -1 "
+                        "(power-of-two serve buckets)",
+                        self.tpu_serve_bucket)
+            self.tpu_serve_bucket = -1
         if self.tpu_compile_cache_cpu not in (0, 1):
             log.warning("tpu_compile_cache_cpu=%d is not 0/1; using 0 "
                         "(off)", self.tpu_compile_cache_cpu)

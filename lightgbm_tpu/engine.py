@@ -175,8 +175,9 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             except Exception:       # noqa: BLE001 — telemetry must
                 pass                # never fail the training result
             try:
-                from .ops import step_cache
+                from .ops import predict_cache, step_cache
                 recorder.meta["step_cache"] = step_cache.stats()
+                recorder.meta["predict_cache"] = predict_cache.stats()
             except Exception:       # noqa: BLE001
                 pass
             recorder.finish(

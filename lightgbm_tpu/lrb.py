@@ -78,7 +78,8 @@ class LrbDriver:
     def __init__(self, cache_size: int, window_size: int,
                  sample_size: int, cutoff: float, sampling: int,
                  result_file=sys.stdout, seed: int = 0,
-                 extra_params: Optional[dict] = None):
+                 extra_params: Optional[dict] = None,
+                 serve_batch: int = 64):
         self.cache_size = cache_size
         self.window_size = window_size
         self.sample_size = sample_size
@@ -101,6 +102,15 @@ class LrbDriver:
         # cumulative by design, like every registry counter)
         self._wall_hist = obs.latency_histogram(
             "lrb/window_wall_s", obs.MetricsRegistry())
+        # serving-path instrument: every evaluation scores the window's
+        # requests against the PREVIOUS window's model in serve-bucket
+        # micro-batches (the retrain-while-serve shape, ROADMAP item
+        # 3); each call's wall lands here as one request latency.
+        # Driver-owned for the same reason as _wall_hist; the global
+        # twin feeds the live exporter.
+        self.serve_batch = max(int(serve_batch), 1)
+        self._serve_hist = obs.latency_histogram(
+            "lrb/serve_latency_s", obs.MetricsRegistry())
         self.booster = None
         self.window = Window()
         self.last_seen: Dict[Tuple[int, int], int] = {}
@@ -305,16 +315,48 @@ class LrbDriver:
                 for k, v in self._wall_hist.quantiles().items()
                 if v is not None}
 
+    def serve_latency_quantiles(self) -> Optional[dict]:
+        """p50/p95/p99 per-request serving latency from the driver's
+        own instrument; None before the first evaluated window."""
+        if not self._serve_hist.count:
+            return None
+        return {k: round(v, 6)
+                for k, v in self._serve_hist.quantiles().items()
+                if v is not None}
+
     def _evaluate_model(self) -> dict:
         labels, X = self._derive_features(0)
-        preds = capi.LGBM_BoosterPredictForMat(
-            self.booster, X, predict_type=capi.C_API_PREDICT_NORMAL)
-        preds = np.asarray(preds)
+        # the serving half of the loop: this window's requests scored
+        # against the previous window's model in micro-batches through
+        # the geometry-keyed predict path (pow2 serve buckets,
+        # ops/predict_cache.py) — every batch after the first rides a
+        # warm compiled program, and each call's wall is one request
+        # latency in the driver-owned histogram
+        n = len(labels)
+        b = self.serve_batch
+        parts = []
+        global_hist = obs.latency_histogram("lrb/serve_latency_s")
+        for r0 in range(0, n, b):
+            t0 = time.monotonic()
+            parts.append(np.asarray(capi.LGBM_BoosterPredictForMat(
+                self.booster, X[r0:r0 + b],
+                predict_type=capi.C_API_PREDICT_NORMAL)))
+            dt = time.monotonic() - t0
+            self._serve_hist.observe(dt)
+            global_hist.observe(dt)
+        preds = (np.concatenate(parts) if parts
+                 else np.zeros(0, np.float64))
         fp = ((labels < self.cutoff) & (preds >= self.cutoff)).sum()
         fn = ((labels >= self.cutoff) & (preds < self.cutoff)).sum()
-        return {"eval_rows": len(labels),
-                "fp_rate": round(float(fp) / max(len(labels), 1), 4),
-                "fn_rate": round(float(fn) / max(len(labels), 1), 4)}
+        out = {"eval_rows": len(labels),
+               "fp_rate": round(float(fp) / max(len(labels), 1), 4),
+               "fn_rate": round(float(fn) / max(len(labels), 1), 4)}
+        p99 = self._serve_hist.percentile(0.99)
+        if p99 is not None:
+            # cumulative across the run so far — the number a live
+            # operator watches; the final summary prints the full set
+            out["serve_p99_ms"] = round(1e3 * p99, 3)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -371,6 +413,11 @@ def main(argv=None):
     if q:
         print("window_wall " + " ".join(f"{k}={v}s"
                                         for k, v in q.items()),
+              file=out)
+    sq = driver.serve_latency_quantiles()
+    if sq:
+        print("serve_latency " + " ".join(f"{k}={1e3 * v:.3f}ms"
+                                          for k, v in sq.items()),
               file=out)
 
 

@@ -368,6 +368,7 @@ class RF(GBDT):
             self.models.append(None)
             self._tree_shrinkage.append(1.0)
         self.iter_ += 1
+        self._bump_model_gen()
         # RF never stops on a splitless bag (rf.hpp TrainOneIter always
         # returns false): a degenerate bagging draw says nothing about
         # later draws, and splitless trees are harmless 1-leaf no-ops
@@ -406,3 +407,7 @@ class RF(GBDT):
         self.iter_ -= 1
         self._clean_groups = min(self._clean_groups, self.iter_)
         self._stopped = False
+        # rollback + retrain lands on the SAME (gen, len) without this
+        # bump — the stacked-predictor fast path would serve the
+        # rolled-back trees
+        self._bump_model_gen()

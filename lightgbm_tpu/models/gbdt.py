@@ -28,6 +28,7 @@ hides it with async copies; we remove the transfers instead).
 from __future__ import annotations
 
 import json
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -86,6 +87,14 @@ class GBDT:
         # gradient sampler (GOSS) and a per-iteration PRNG stream
         self._sample_hook = None
         self._hook_rng = None
+        # serving-path state: the cached StackedModel, the exact tree
+        # objects it stacked (identity-checked for incremental extend),
+        # and the lock that keeps a predict() racing a retrain from
+        # ever seeing a half-built predictor (RLock: _bump_model_gen
+        # runs under it from paths _stacked_model may itself trigger)
+        self._stacked_lock = threading.RLock()
+        self._stacked_cache = None
+        self._stacked_ref: Optional[List] = None
 
     # -- init (gbdt.cpp:47-117) --------------------------------------------
 
@@ -105,6 +114,12 @@ class GBDT:
         # process-wide compiled-step registry (ops/step_cache.py):
         # eligible boosters share ONE jitted training step per geometry
         step_cache.configure(config.tpu_step_cache, config.tpu_row_bucket)
+        # ... and its serving twin (ops/predict_cache.py): stacked
+        # predict dispatch keyed by explicit geometry, online batches
+        # padded to serve buckets
+        from ..ops import predict_cache
+        predict_cache.configure(config.tpu_predict_cache,
+                                config.tpu_serve_bucket)
         # streaming telemetry (obs/): the span tracer and the live
         # metrics exporter are process-global daemons — the first
         # booster with the knobs set starts them, every later one
@@ -1432,29 +1447,94 @@ class GBDT:
             tree.shrinkage = self._tree_shrinkage[i]
             self.models[i] = tree
 
+    def _stacked_guard(self) -> threading.RLock:
+        """The serving-path lock — created lazily for instances
+        deserialized around __init__ (copy/pickle shims)."""
+        lk = getattr(self, "_stacked_lock", None)
+        if lk is None:
+            lk = self._stacked_lock = threading.RLock()
+        return lk
+
     def _bump_model_gen(self) -> None:
         """Invalidate prediction caches — call from every path that
-        mutates the ensemble (train, rollback, refit, load)."""
-        self._model_gen = getattr(self, "_model_gen", 0) + 1
+        mutates the ensemble (train, rollback, refit, load). Runs
+        under the serving lock so a concurrent predict() never reads a
+        generation that is mid-bump."""
+        with self._stacked_guard():
+            self._model_gen = getattr(self, "_model_gen", 0) + 1
+
+    def _invalidate_stacked(self) -> None:
+        """Hard-drop the stacked predictor. Needed by paths that
+        mutate a host tree IN PLACE (LGBM_BoosterSetLeafValue): tree
+        identity survives such edits, so the prefix-reuse check in
+        _stacked_model cannot see them — the stale stacks must go."""
+        with self._stacked_guard():
+            self._model_gen = getattr(self, "_model_gen", 0) + 1
+            self._stacked_cache = None
+            self._stacked_ref = None
 
     def _stacked_model(self):
         """Cached whole-ensemble device predictor (ops/stacked_predict);
-        None when the model shape can't be stacked."""
-        self._ensure_host_trees()
-        key = (getattr(self, "_model_gen", 0), len(self.models))
-        cached = getattr(self, "_stacked_cache", None)
-        if cached is not None and cached[0] == key:
-            return cached[1]
-        from ..ops.stacked_predict import StackedModel
-        nf = self.max_feature_idx + 1
-        if nf <= 0 and self.models:
-            nf = max([max(t.split_feature, default=-1)
-                      for t in self.models]) + 1
-        sm = StackedModel(self.models, max(nf, 1),
-                          self.num_tree_per_iteration)
-        sm = sm if sm.ok else None
-        self._stacked_cache = (key, sm)
-        return sm
+        None when the model shape can't be stacked.
+
+        Serving-grade reuse: the whole check-build-publish runs under
+        one lock (a predict() during a retrain serializes behind the
+        build instead of racing a half-built StackedModel), and a
+        generation bump no longer forces a full re-stack — when the
+        previously stacked trees are still a prefix of the live
+        ensemble (continued training appends; rollback trims), the
+        cached predictor is EXTENDED with only the new tree chunk
+        (StackedModel.extend) or reused as-is with the caller's ntree
+        slicing. Only a genuinely different ensemble (retrain on a
+        fresh booster, refit, shuffle, load) pays a full stack."""
+        with self._stacked_guard():
+            # snapshot BOUND first: a training thread may append a
+            # record (models gains a not-yet-materialized None tail
+            # entry) at any moment — everything below operates on the
+            # prefix that existed here, which _ensure_host_trees is
+            # guaranteed to have materialized
+            n_live = len(self.models)
+            self._ensure_host_trees()
+            models = list(self.models[:n_live])
+            key = (getattr(self, "_model_gen", 0), len(models))
+            cached = getattr(self, "_stacked_cache", None)
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            sm = None
+            prev = cached[1] if cached is not None else None
+            # invariant: _stacked_ref lists EXACTLY the tree objects
+            # prev has stacked, in order — every reuse decision below
+            # is an identity check against it
+            ref = getattr(self, "_stacked_ref", None)
+            if prev is not None and prev.ok and ref:
+                shared = min(len(ref), len(models))
+                if all(a is b for a, b in zip(ref[:shared],
+                                              models[:shared])):
+                    if len(models) <= len(ref):
+                        # trim/rollback or a pure gen bump: the stacks
+                        # already cover every live tree — predict()
+                        # slices by ntree; ref keeps describing prev's
+                        # FULL contents (a later append on top of the
+                        # trim must not extend past stale positions)
+                        sm = prev
+                    elif prev.extend(models[len(ref):]):
+                        sm = prev
+                        self._stacked_ref = models
+            if sm is None:
+                from ..ops.stacked_predict import StackedModel
+                nf = self.max_feature_idx + 1
+                if nf <= 0 and models:
+                    nf = max([max(t.split_feature, default=-1)
+                              for t in models]) + 1
+                cfg = self.config
+                sm = StackedModel(
+                    models, max(nf, 1), self.num_tree_per_iteration,
+                    serve_bucket=(cfg.tpu_serve_bucket
+                                  if cfg is not None else None))
+                sm = sm if sm.ok else None
+                self._stacked_ref = models if sm is not None else None
+            self._stacked_cache = (key, sm)
+            return sm
 
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:414-430). Training may resume
@@ -1579,7 +1659,11 @@ class GBDT:
             if self.average_output:
                 out /= max((ntree - first) // k, 1)
             return out[0] if k == 1 else out.T
-        sm = (self._stacked_model() if (ntree - first) >= 4 and n >= 256
+        # no row floor: with serve buckets (ops/predict_cache.py) a
+        # 1-row online request rides the same warm compiled program as
+        # a 4096-row batch — the host walk stays only for tiny
+        # ensembles where stacking cannot pay for itself
+        sm = (self._stacked_model() if (ntree - first) >= 4 and n >= 1
               else None)
         if sm is not None:
             # whole-ensemble MXU scan: one dispatch chain instead of one
@@ -1633,7 +1717,7 @@ class GBDT:
         ntree = self._effective_num_models()
         if num_iteration >= 0:
             ntree = min(ntree, num_iteration * self.num_tree_per_iteration)
-        sm = (self._stacked_model() if ntree >= 4 and X.shape[0] >= 256
+        sm = (self._stacked_model() if ntree >= 4 and X.shape[0] >= 1
               else None)
         if sm is not None:
             return sm.predict(X, 0, ntree, pred_leaf=True)
@@ -1902,7 +1986,7 @@ class GBDT:
                 # cross-chip traffic: every root/wave histogram pass
                 # moves one [W, F, B, C] block through the psum
                 self.record_comm_bytes(recorder, waves)
-            from ..ops import step_cache
+            from ..ops import predict_cache, step_cache
             # registry totals are process-wide; booster_eligible is
             # THIS booster's routing (the global "enabled" is
             # last-init-wins and may describe a different booster)
@@ -1910,6 +1994,7 @@ class GBDT:
                 step_cache.stats(),
                 booster_eligible=bool(getattr(self, "_cache_eligible",
                                               False)))
+            recorder.meta["predict_cache"] = predict_cache.stats()
             recorder.finish(
                 leaves_per_iteration=leaves, waves_per_iteration=waves,
                 extra={"trained_iterations": self.iter_,
@@ -1920,8 +2005,10 @@ class GBDT:
             # the normal path above already finished with leaf counts)
             profile.close()
             self._recorder = None
-            from ..ops import step_cache
+            from ..ops import predict_cache, step_cache
             recorder.meta.setdefault("step_cache", step_cache.stats())
+            recorder.meta.setdefault("predict_cache",
+                                     predict_cache.stats())
             recorder.finish(extra={"aborted": True})
         timing.log_report("training phase timings "
                           "(serial_tree_learner.cpp:14-41 analog)")

@@ -99,6 +99,60 @@ def test_compare_throughput_and_auc():
     assert any("no test_auc" in p for p in cbr.compare(fresh, base))
 
 
+def test_compare_latency_gate():
+    """predict_latency p50/p99 within --latency-tol of a baseline that
+    carries the quantiles; old baselines without the field gate
+    nothing; a fresh run that lost the field cannot silently pass."""
+    lat = {"p50_ms": 10.0, "p95_ms": 15.0, "p99_ms": 20.0}
+    base = _fresh(predict_latency=dict(lat))
+    # within 50%: pass
+    ok = _fresh(predict_latency={"p50_ms": 14.0, "p95_ms": 21.0,
+                                 "p99_ms": 29.0})
+    assert cbr.compare(ok, base) == []
+    # p50 beyond tolerance: regression names the quantile
+    slow = _fresh(predict_latency={"p50_ms": 16.0, "p95_ms": 16.0,
+                                   "p99_ms": 21.0})
+    probs = cbr.compare(slow, base)
+    assert probs and "latency regression" in probs[0] \
+        and "p50_ms" in probs[0]
+    # p99 tail regression caught independently of a healthy p50
+    tail = _fresh(predict_latency={"p50_ms": 9.0, "p95_ms": 16.0,
+                                   "p99_ms": 40.0})
+    probs = cbr.compare(tail, base)
+    assert len(probs) == 1 and "p99_ms" in probs[0]
+    # tolerance flag respected
+    assert cbr.compare(slow, base, latency_tol=1.0) == []
+    # baseline predates the field: nothing to gate
+    assert cbr.compare(_fresh(predict_latency={"p50_ms": 999.0,
+                                               "p95_ms": 999.0,
+                                               "p99_ms": 999.0}),
+                       _fresh()) == []
+    # fresh LOST the field vs a baseline that has it
+    probs = cbr.compare(_fresh(), base)
+    assert any("no predict_latency" in p for p in probs)
+    # cross-workload refusal still wins over everything
+    probs = cbr.compare(_fresh(metric="other", predict_latency=lat),
+                        base)
+    assert len(probs) == 1 and "not comparable" in probs[0]
+
+
+def test_cli_latency_tol_flag(tmp_path):
+    """--latency-tol reaches the comparison (exit 1 at the default,
+    exit 0 when widened)."""
+    base_dir = tmp_path / "repo"
+    base_dir.mkdir()
+    lat = {"p50_ms": 10.0, "p95_ms": 15.0, "p99_ms": 20.0}
+    (base_dir / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": _fresh(value=49.0, predict_latency=lat)}))
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(_fresh(
+        value=49.0, predict_latency={"p50_ms": 18.0, "p95_ms": 20.0,
+                                     "p99_ms": 22.0})))
+    assert cbr.main([str(slow), "--baseline-dir", str(base_dir)]) == 1
+    assert cbr.main([str(slow), "--baseline-dir", str(base_dir),
+                     "--latency-tol", "1.0"]) == 0
+
+
 def test_compare_refuses_cross_workload():
     base = _fresh(metric="HIGGS 11000000 rows")
     probs = cbr.compare(_fresh(metric="quick 65536 rows", value=1.0),

@@ -1,0 +1,281 @@
+"""Serving path (ops/predict_cache.py + the StackedModel serving
+refactor): geometry-keyed predict registry, pow2 serve buckets, and
+incremental forest stacking.
+
+The contract under test: online micro-batches (1..4096 rows) are
+BIT-equal to one full-batch predict (pad rows are independent and
+sliced off), a retrained same-geometry model hits a warm registry
+entry instead of re-tracing, appending trees re-stacks only the new
+chunk, and a predict() racing a retrain never sees a half-built
+predictor (the thread-safety satellite).
+
+``pytest -m serving``.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from conftest import (TEST_PARAMS, fit_gbdt, make_binary,
+                      make_multiclass)
+
+from lightgbm_tpu.ops import predict_cache
+
+pytestmark = pytest.mark.serving
+
+
+# -- serve bucket policy (pure units) ----------------------------------------
+
+def test_serve_bucket_rows_policy():
+    # auto: pow2, floor 16
+    assert predict_cache.serve_bucket_rows(1, -1) == 16
+    assert predict_cache.serve_bucket_rows(16, -1) == 16
+    assert predict_cache.serve_bucket_rows(17, -1) == 32
+    assert predict_cache.serve_bucket_rows(4096, -1) == 4096
+    assert predict_cache.serve_bucket_rows(4097, -1) == 8192
+    # above 16k: pow2/16 steps (pad capped at ~1/8, 8 buckets/octave)
+    assert predict_cache.serve_bucket_rows(1 << 14, -1) == 1 << 14
+    b = predict_cache.serve_bucket_rows(20000, -1)
+    assert b >= 20000 and b % 1024 == 0 and b - 20000 < 20000 / 8
+    # exact shapes
+    assert predict_cache.serve_bucket_rows(37, 0) == 37
+    # multiple-of-N
+    assert predict_cache.serve_bucket_rows(37, 50) == 50
+    assert predict_cache.serve_bucket_rows(120, 50) == 150
+
+
+# -- micro-batch bit-parity vs full batch ------------------------------------
+
+def _microbatch(g, X, sizes, **kw):
+    """Concatenated predict_raw over a stream of odd batch sizes."""
+    parts, r0 = [], 0
+    i = 0
+    while r0 < len(X):
+        b = sizes[i % len(sizes)]
+        parts.append(np.atleast_1d(g.predict_raw(X[r0:r0 + b], **kw)))
+        r0 += b
+        i += 1
+    return np.concatenate(parts, axis=0)
+
+
+def test_microbatch_bit_equal_binary():
+    X, y = make_binary(n=1500, f=6, seed=3)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
+                 num_round=15)
+    Xt = np.random.default_rng(1).normal(size=(700, 6))
+    Xt[::13, 2] = np.nan
+    full = g.predict_raw(Xt)
+    got = _microbatch(g, Xt, (1, 3, 64, 117, 256))
+    np.testing.assert_array_equal(got, full)
+
+
+def test_microbatch_bit_equal_multiclass():
+    X, y = make_multiclass(n=1200, f=5, k=3, seed=5)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="multiclass",
+                            num_class=3), num_round=8)
+    Xt = np.random.default_rng(2).normal(size=(500, 5))
+    full = g.predict_raw(Xt)
+    got = _microbatch(g, Xt, (2, 65, 130))
+    np.testing.assert_array_equal(got, full)
+
+
+def test_microbatch_bit_equal_pred_leaf():
+    X, y = make_binary(n=1200, f=6, seed=7)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
+                 num_round=12)
+    Xt = np.random.default_rng(3).normal(size=(400, 6))
+    full = g.predict_leaf_index(Xt)
+    parts = [g.predict_leaf_index(Xt[r0:r0 + 37])
+             for r0 in range(0, 400, 37)]
+    np.testing.assert_array_equal(np.concatenate(parts, axis=0), full)
+
+
+def test_xla_scan_and_pallas_buckets_bit_equal():
+    """Bucketed (serve policy -1) vs unbucketed (policy 0) predict is
+    bit-identical on BOTH device paths — the XLA scan fallback and the
+    fused Pallas forest kernel (interpret mode off-TPU)."""
+    from lightgbm_tpu.ops.stacked_predict import StackedModel
+    X, y = make_binary(n=1200, f=6, seed=11)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
+                 num_round=10)
+    g._ensure_host_trees()
+    F = g.max_feature_idx + 1
+    bucketed = StackedModel(g.models, F, 1, serve_bucket=-1)
+    exact = StackedModel(g.models, F, 1, serve_bucket=0)
+    Xt = np.random.default_rng(4).normal(size=(137, 6))
+    Xt[::9, 1] = np.nan
+    np.testing.assert_array_equal(bucketed.predict(Xt),
+                                  exact.predict(Xt))
+    np.testing.assert_array_equal(
+        bucketed.predict(Xt, use_pallas=True),
+        exact.predict(Xt, use_pallas=True))
+    np.testing.assert_array_equal(
+        bucketed.predict(Xt, pred_leaf=True),
+        exact.predict(Xt, pred_leaf=True))
+
+
+# -- registry: cross-model reuse ---------------------------------------------
+
+def test_registry_hits_after_same_geometry_retrain():
+    """The lrb shape: a FRESH booster on same-shaped data lands on the
+    same predict geometry — its dispatch is a registry HIT (warm
+    compiled program), and each model pays exactly one full stack."""
+    params = dict(TEST_PARAMS, objective="binary")
+    X, y = make_binary(n=1500, f=6, seed=13)
+    Xt = np.random.default_rng(5).normal(size=(64, 6))
+
+    g1 = fit_gbdt(X, y, params, num_round=10)
+    g1.predict_raw(Xt)                       # builds + registers
+    s0 = predict_cache.stats()
+    # retrain: fresh booster, same data shape -> same geometry
+    X2, y2 = make_binary(n=1500, f=6, seed=14)
+    g2 = fit_gbdt(X2, y2, params, num_round=10)
+    g2.predict_raw(Xt)
+    s1 = predict_cache.stats()
+    assert s1["hits"] - s0["hits"] >= 1, \
+        "same-geometry retrain must hit the warm predict registry"
+    assert s1["misses"] == s0["misses"], \
+        "same-geometry retrain must not mint a new dispatch"
+    assert s1["stacks"] - s0["stacks"] == 1      # g2's one full stack
+    # same model, same batch bucket again: memoized per-instance, no
+    # new registry traffic at all
+    g2.predict_raw(Xt[:32])                  # same 16..64 bucket? 32->32
+    s2 = predict_cache.stats()
+    assert s2["stacks"] == s1["stacks"]
+
+
+def test_registry_disabled_still_correct():
+    """tpu_predict_cache=0: no registry bookkeeping, identical
+    results."""
+    params = dict(TEST_PARAMS, objective="binary",
+                  tpu_predict_cache=0)
+    X, y = make_binary(n=1200, f=6, seed=17)
+    g = fit_gbdt(X, y, params, num_round=8)
+    Xt = np.random.default_rng(6).normal(size=(100, 6))
+    s0 = predict_cache.stats()
+    full = g.predict_raw(Xt)
+    got = _microbatch(g, Xt, (7, 33))
+    np.testing.assert_array_equal(got, full)
+    assert predict_cache.stats()["hits"] == s0["hits"]
+    assert predict_cache.stats()["misses"] == s0["misses"]
+
+
+# -- incremental forest stacking ---------------------------------------------
+
+def test_extend_on_continued_training_bit_equal():
+    """predict -> train more -> predict re-stacks ONLY the appended
+    chunk (extends counter), and the extended predictor is bit-equal
+    to a from-scratch stack of the full ensemble."""
+    from lightgbm_tpu.ops.stacked_predict import StackedModel
+    X, y = make_binary(n=1500, f=6, seed=19)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
+                 num_round=10)
+    Xt = np.random.default_rng(7).normal(size=(300, 6))
+    first = g.predict_raw(Xt)
+    assert first.shape == (300,)
+    s0 = predict_cache.stats()
+    for _ in range(5):
+        g.train_one_iter()
+    got = g.predict_raw(Xt)
+    s1 = predict_cache.stats()
+    assert s1["extends"] - s0["extends"] == 1, \
+        "continued training must extend, not re-stack"
+    assert s1["stacks"] == s0["stacks"]
+    g._ensure_host_trees()
+    fresh = StackedModel(g.models, g.max_feature_idx + 1, 1)
+    np.testing.assert_array_equal(
+        got, fresh.predict(np.ascontiguousarray(Xt))[0])
+
+
+def test_rollback_reuses_stacks_then_rebuilds_cleanly():
+    """Rollback keeps the cached stacks (predict slices by ntree);
+    training past a rollback must NOT extend over stale positions."""
+    X, y = make_binary(n=1500, f=6, seed=23)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
+                 num_round=12)
+    Xt = np.random.default_rng(8).normal(size=(200, 6))
+    g.predict_raw(Xt)
+    s0 = predict_cache.stats()
+    g.rollback_one_iter()
+    want_11 = g.predict_raw(Xt)              # 11 trees, reused stacks
+    s1 = predict_cache.stats()
+    assert s1["stacks"] == s0["stacks"]
+    assert s1["extends"] == s0["extends"]
+    # grow past the rollback point: positions diverge from the stacked
+    # ref -> full rebuild, and the result reflects the NEW trees
+    g.train_one_iter()
+    got = g.predict_raw(Xt)
+    assert got.shape == want_11.shape
+    from lightgbm_tpu.ops.stacked_predict import StackedModel
+    g._ensure_host_trees()
+    fresh = StackedModel(g.models, g.max_feature_idx + 1, 1)
+    np.testing.assert_array_equal(
+        got, fresh.predict(np.ascontiguousarray(Xt))[0])
+
+
+def test_set_leaf_value_invalidates_stacked():
+    """In-place leaf edits keep tree identity — the stacked predictor
+    must be dropped explicitly, or serving would use stale leaves."""
+    from lightgbm_tpu import capi
+    X, y = make_binary(n=800, f=5, seed=29)
+    params = "objective=binary num_leaves=15 min_data_in_leaf=20"
+    ds = capi.LGBM_DatasetCreateFromMat(X, parameters=params)
+    capi.LGBM_DatasetSetField(ds, "label", y)
+    bst = capi.LGBM_BoosterCreate(ds, params)
+    for _ in range(6):
+        capi.LGBM_BoosterUpdateOneIter(bst)
+    Xt = X[:64]
+    before = np.asarray(capi.LGBM_BoosterPredictForMat(
+        bst, Xt, predict_type=capi.C_API_PREDICT_RAW_SCORE))
+    old = capi.LGBM_BoosterGetLeafValue(bst, 0, 0)
+    capi.LGBM_BoosterSetLeafValue(bst, 0, 0, old + 5.0)
+    after = np.asarray(capi.LGBM_BoosterPredictForMat(
+        bst, Xt, predict_type=capi.C_API_PREDICT_RAW_SCORE))
+    leaf0 = np.asarray(capi.LGBM_BoosterPredictForMat(
+        bst, Xt, predict_type=capi.C_API_PREDICT_LEAF_INDEX))[:, 0]
+    hit = leaf0 == 0
+    assert hit.any() and not hit.all()
+    np.testing.assert_allclose(after[hit], before[hit] + 5.0,
+                               atol=1e-5)
+    np.testing.assert_allclose(after[~hit], before[~hit], atol=1e-6)
+
+
+# -- thread safety: predict while retraining ---------------------------------
+
+def test_predict_during_training_is_safe():
+    """Concurrent predict() calls while the booster trains more trees:
+    no crash, no half-built predictor, every result equals a clean
+    predict at SOME consistent tree count (prefix snapshots)."""
+    X, y = make_binary(n=1200, f=6, seed=31)
+    g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
+                 num_round=8)
+    Xt = np.ascontiguousarray(
+        np.random.default_rng(9).normal(size=(64, 6)))
+    g.predict_raw(Xt)                        # warm build
+    errors = []
+    results = []
+    stop = threading.Event()
+
+    def serve():
+        try:
+            while not stop.is_set():
+                results.append(g.predict_raw(Xt))
+        except Exception as e:               # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=serve) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(8):
+        g.train_one_iter()
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert results
+    # every observed result matches a clean single-threaded predict at
+    # one of the tree counts that existed during the run
+    valid = {n: g.predict_raw(Xt, num_iteration=n)
+             for n in range(8, 17)}
+    for r in results:
+        assert any(np.array_equal(r, v) for v in valid.values())

@@ -12,6 +12,11 @@ the numbers. This tool makes the comparison mechanical:
 - **quality**: the fresh run's test AUC must be no more than
   ``--auc-tol`` (default 2e-3) below the latest baseline's (parsed from
   the wrapper's stderr tail when the JSON predates the in-line field);
+- **serving latency**: the fresh run's ``predict_latency`` p50/p99 must
+  be within ``--latency-tol`` (default 50% — per-request walls on
+  shared hosts are far noisier than throughput) of the latest baseline
+  that CARRIES the quantiles; trajectory points predating the field are
+  skipped, never treated as a zero-latency baseline;
 - **comparability**: the bench ``metric`` string embeds the workload
   shape (rows x features, leaves, bins, iters, chips) — a quick run is
   refused against a full-size baseline instead of "passing" a
@@ -37,6 +42,7 @@ from typing import List, Optional
 
 DEFAULT_THROUGHPUT_TOL = 0.20
 DEFAULT_AUC_TOL = 2e-3
+DEFAULT_LATENCY_TOL = 0.50
 
 # the wrapper's stderr tail carries the AUC line for trajectory points
 # that predate the in-JSON train_auc/test_auc fields
@@ -97,7 +103,8 @@ def check_schema(fresh: dict) -> List[str]:
 
 def compare(fresh: dict, baseline: dict,
             throughput_tol: float = DEFAULT_THROUGHPUT_TOL,
-            auc_tol: float = DEFAULT_AUC_TOL) -> List[str]:
+            auc_tol: float = DEFAULT_AUC_TOL,
+            latency_tol: float = DEFAULT_LATENCY_TOL) -> List[str]:
     """Regression problems of ``fresh`` vs one ``baseline`` point
     (both normalized); empty list == pass. Refuses cross-workload
     comparisons (the metric strings embed the shape)."""
@@ -120,6 +127,36 @@ def compare(fresh: dict, baseline: dict,
                 f"{ba:.5f} - {auc_tol:g}")
     elif isinstance(ba, (int, float)):
         problems.append("fresh run carries no test_auc to compare")
+    problems += _compare_latency(fresh, baseline, latency_tol)
+    return problems
+
+
+def _compare_latency(fresh: dict, baseline: dict,
+                     latency_tol: float) -> List[str]:
+    """predict_latency p50/p99 gate. Only fires when the BASELINE
+    carries numeric quantiles (points predating the field gate
+    nothing); a fresh run that LOST the field against a baseline that
+    has it is itself a problem — the serving ledger must not silently
+    disappear."""
+    blat = baseline.get("predict_latency")
+    if not isinstance(blat, dict):
+        return []
+    flat = fresh.get("predict_latency")
+    problems = []
+    for q in ("p50_ms", "p99_ms"):
+        bq = blat.get(q)
+        if not isinstance(bq, (int, float)):
+            continue
+        fq = (flat or {}).get(q) if isinstance(flat, dict) else None
+        if not isinstance(fq, (int, float)):
+            problems.append(
+                f"fresh run carries no predict_latency.{q} to compare")
+            continue
+        ceil = (1.0 + latency_tol) * bq
+        if fq > ceil:
+            problems.append(
+                f"latency regression: predict {q} {fq:g} ms > "
+                f"{ceil:g} (baseline {bq:g} + {latency_tol:.0%})")
     return problems
 
 
@@ -141,6 +178,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "latest baseline (default 0.20)")
     ap.add_argument("--auc-tol", type=float, default=DEFAULT_AUC_TOL,
                     help="allowed absolute test-AUC drop (default 2e-3)")
+    ap.add_argument("--latency-tol", type=float,
+                    default=DEFAULT_LATENCY_TOL,
+                    help="allowed fractional predict-latency p50/p99 "
+                         "increase vs the latest baseline carrying the "
+                         "quantiles (default 0.50 — per-request walls "
+                         "are noisier than throughput)")
     ap.add_argument("--schema-only", action="store_true",
                     help="validate the fresh artifact's shape only "
                          "(quick runs are not comparable to the "
@@ -169,7 +212,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     baseline = load_bench(points[-1])
     problems = compare(fresh, baseline, args.throughput_tol,
-                       args.auc_tol)
+                       args.auc_tol, args.latency_tol)
     if problems:
         for p in problems:
             print(f"REGRESSION vs {os.path.basename(points[-1])}: {p}",
