@@ -115,8 +115,9 @@ class Application:
 
         booster = create_boosting(cfg.boosting_type())
         if cfg.input_model:
-            with open(_rel_to_config(cfg, cfg.input_model)) as fh:
-                booster.load_model_from_string(fh.read())
+            in_path = _rel_to_config(cfg, cfg.input_model)
+            with open(in_path) as fh:
+                booster.load_model_from_string(fh.read(), source=in_path)
             booster.init_from_loaded(cfg, train_data, objective,
                                      train_metrics)
         else:
@@ -129,7 +130,10 @@ class Application:
                                       vdata.num_data)
             booster.add_valid_data(vdata, vmetrics,
                                    os.path.basename(vpath))
-        booster.train(cfg.snapshot_freq, cfg.output_model)
+        # tpu_resume_from: continue a killed run from its checkpoint
+        # bundle/dir, bit-identically (utils/checkpoint.py)
+        booster.train(cfg.snapshot_freq, cfg.output_model,
+                      resume_from=cfg.tpu_resume_from)
 
     def refit(self) -> None:
         """Task refit: re-learn input_model's leaf values on `data`
@@ -145,7 +149,7 @@ class Application:
             objective.init(train_data.metadata, train_data.num_data)
         booster = create_boosting(cfg.boosting_type())
         with open(model_path) as fh:
-            booster.load_model_from_string(fh.read())
+            booster.load_model_from_string(fh.read(), source=model_path)
         booster.init_from_loaded(cfg, train_data, objective, [])
         booster.refit_existing()
         booster.save_model_to_file(cfg.output_model)
@@ -161,7 +165,7 @@ class Application:
                       "input_model for the predict task")
         booster = GBDT()
         with open(model_path) as fh:
-            booster.load_model_from_string(fh.read())
+            booster.load_model_from_string(fh.read(), source=model_path)
         loader = DatasetLoader(cfg)
         data_path = _rel_to_config(cfg, cfg.data)
         X, _ = loader.load_predict_matrix(data_path,
@@ -202,7 +206,7 @@ class Application:
         from .models.codegen import model_to_if_else
         booster = GBDT()
         with open(model_path) as fh:
-            booster.load_model_from_string(fh.read())
+            booster.load_model_from_string(fh.read(), source=model_path)
         code = model_to_if_else(booster)
         with open(cfg.convert_model, "w") as fh:
             fh.write(code)

@@ -794,6 +794,14 @@ class Booster:
         self._init_from_string(model_str)
         return self
 
+    def save_checkpoint(self, directory: str) -> Optional[str]:
+        """Write a resumable checkpoint bundle (utils/checkpoint.py):
+        the model text plus the training state a restart needs to
+        continue bit-identically. Returns the path, or None on a
+        failure (which warns and never raises — the engine.train
+        periodic wiring calls this mid-run)."""
+        return self._gbdt.write_checkpoint(directory)
+
     def free_dataset(self) -> "Booster":
         self.train_set = None
         self.valid_sets = []
@@ -820,7 +828,8 @@ class _InnerPredictor:
         elif model_file is not None:
             with open(model_file) as fh:
                 model_str = fh.read()
-            self._gbdt = GBDT().load_model_from_string(model_str)
+            self._gbdt = GBDT().load_model_from_string(
+                model_str, source=model_file)
         elif model_str is not None:
             self._gbdt = GBDT().load_model_from_string(model_str)
         else:

@@ -444,6 +444,42 @@ class Config:
     # process runs — point a scraper at a live training/serving loop.
     # 0 = off.
     tpu_metrics_port: int = 0
+    # resumable checkpoints (utils/checkpoint.py): directory for
+    # versioned JSON checkpoint bundles — the model text PLUS the
+    # training state the model text lacks (iteration, bagging/feature/
+    # GOSS/DART RNG streams, current bagging mask, early-stopping
+    # bookkeeping, eval history, config fingerprint) — written
+    # atomically every tpu_checkpoint_freq iterations by the gbdt.train
+    # snapshot loop and engine.train, pruned to tpu_snapshot_keep. A
+    # run resumed from a bundle continues BIT-IDENTICALLY to the
+    # uninterrupted run (tests/test_faults.py kill-and-resume drill).
+    # Empty = no checkpoints.
+    tpu_checkpoint_dir: str = ""
+    # iterations between checkpoint writes (0 = off). A failed write
+    # warns and training continues — the previous complete checkpoint
+    # is never corrupted (atomic replace).
+    tpu_checkpoint_freq: int = 0
+    # resume training from this checkpoint bundle (or directory — the
+    # newest valid bundle wins; corrupt ones are skipped with a
+    # warning). Refused with an actionable message when the training
+    # config fingerprint differs. CLI analog of
+    # GBDT.train(resume_from=...).
+    tpu_resume_from: str = ""
+    # model snapshots (save_period) AND checkpoint bundles retained;
+    # older ones are pruned after each successful write (floor 1).
+    tpu_snapshot_keep: int = 3
+    # deterministic fault injection (utils/faults.py) for recovery
+    # drills: "point@N[:action][;...]" — e.g.
+    # "lrb.window_train@2:transient;train.iter@17:kill". Tests and
+    # game-day drills only; empty = disarmed.
+    tpu_faults: str = ""
+    # seed for probability-based fault rules (point@p0.25) so drills
+    # reproduce exactly
+    tpu_fault_seed: int = 0
+    # total attempts for transient-failure retries (utils/retry.py
+    # bounded exponential backoff + jitter) on the ingest/transfer
+    # seams and the lrb window-train path
+    tpu_retry_attempts: int = 4
 
     def __post_init__(self):
         self._raw_params: Dict[str, str] = {}
@@ -632,6 +668,22 @@ class Config:
             log.warning("tpu_trace_buffer=%d is below the floor; "
                         "using 1024", self.tpu_trace_buffer)
             self.tpu_trace_buffer = 1024
+        if self.tpu_checkpoint_freq < 0:
+            log.warning("tpu_checkpoint_freq=%d is negative; disabling "
+                        "checkpoints (0)", self.tpu_checkpoint_freq)
+            self.tpu_checkpoint_freq = 0
+        if self.tpu_checkpoint_freq > 0 and not self.tpu_checkpoint_dir:
+            log.warning("tpu_checkpoint_freq=%d but tpu_checkpoint_dir "
+                        "is empty; no checkpoints will be written",
+                        self.tpu_checkpoint_freq)
+        if self.tpu_snapshot_keep < 1:
+            log.warning("tpu_snapshot_keep=%d is below the floor; "
+                        "using 1", self.tpu_snapshot_keep)
+            self.tpu_snapshot_keep = 1
+        if self.tpu_retry_attempts < 1:
+            log.warning("tpu_retry_attempts=%d is below the floor; "
+                        "using 1 (no retries)", self.tpu_retry_attempts)
+            self.tpu_retry_attempts = 1
         if self.tpu_metrics_interval_s <= 0:
             log.warning("tpu_metrics_interval_s=%g is not positive; "
                         "using 5.0", self.tpu_metrics_interval_s)

@@ -156,7 +156,9 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         evaluation_result_list = _train_loop(
             booster, params, init_iteration, num_boost_round,
             callbacks_before_iter, callbacks_after_iter, fobj, feval,
-            valid_sets, is_valid_contain_train, profile)
+            valid_sets, is_valid_contain_train, profile,
+            ckpt_dir=str(params.get("tpu_checkpoint_dir", "") or ""),
+            ckpt_freq=int(params.get("tpu_checkpoint_freq", 0) or 0))
     finally:
         profile.close()
         if recorder is not None:
@@ -195,7 +197,7 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
 def _train_loop(booster, params, init_iteration, num_boost_round,
                 callbacks_before_iter, callbacks_after_iter, fobj,
                 feval, valid_sets, is_valid_contain_train,
-                profile=None):
+                profile=None, ckpt_dir: str = "", ckpt_freq: int = 0):
     evaluation_result_list: List[tuple] = []
     want_eval = valid_sets is not None or feval is not None
     # pipelined evaluation: when every metric evaluates on device
@@ -248,6 +250,13 @@ def _train_loop(booster, params, init_iteration, num_boost_round,
         booster.update(fobj=fobj)
         if profile is not None:
             profile.iter_end(i - init_iteration + 1)
+        # resumable checkpoint bundle (utils/checkpoint.py): atomic
+        # write, pruned, warns-never-raises on failure. Written only
+        # AFTER this iteration's evals are processed (the gbdt.train
+        # flush-first rule): a bundle must never capture a tree an
+        # early stop is about to roll back.
+        ckpt_due = (ckpt_freq > 0 and ckpt_dir
+                    and (i + 1 - init_iteration) % ckpt_freq == 0)
 
         handles = (booster.eval_dispatch_async(is_valid_contain_train)
                    if pipelined else None)
@@ -260,6 +269,8 @@ def _train_loop(booster, params, init_iteration, num_boost_round,
                 results.extend(booster.eval_valid(feval))
             if run_after_cbs(i, results):
                 return evaluation_result_list
+            if ckpt_due:
+                booster.save_checkpoint(ckpt_dir)
             continue
         if pending is not None:
             pi, ph = pending
@@ -268,6 +279,14 @@ def _train_loop(booster, params, init_iteration, num_boost_round,
                 booster.rollback_one_iter()
                 return evaluation_result_list
         pending = (i, handles)
+        if ckpt_due:
+            # drain the one-deep lookahead so the stop decision for
+            # THIS iteration lands before the bundle is written
+            pi, ph = pending
+            pending = None
+            if run_after_cbs(pi, booster.eval_materialize(ph)):
+                return evaluation_result_list
+            booster.save_checkpoint(ckpt_dir)
     if pending is not None:
         pi, ph = pending
         run_after_cbs(pi, booster.eval_materialize(ph))

@@ -133,31 +133,64 @@ def auto_chunk_rows(config, n_features: int, itemsize: int) -> int:
     return int(min(max(c, _MIN_CHUNK_ROWS), _MAX_CHUNK_ROWS))
 
 
-def prefetch(thunks, depth: int = 2):
+class PrefetchError(RuntimeError):
+    """A prefetch thunk failed after retries; the message carries the
+    chunk index so a dead pipeline names WHERE it died. The original
+    failure rides ``__cause__``."""
+
+
+def prefetch(thunks, depth: int = 2, what: str = "chunk",
+             policy=None):
     """Evaluate an iterator of zero-arg callables on ONE worker thread
     with a bounded lookahead, yielding results in order — the host
     half of the double buffer: while the device chews on chunk k, the
     worker slices/keys chunk k+1. One thread is deliberate: host prep
-    is memory-bandwidth bound and the results must stay ordered."""
+    is memory-bandwidth bound and the results must stay ordered.
+
+    Fault tolerance: each thunk runs under the bounded-backoff retry
+    policy (utils/retry.py; ``policy`` — e.g. the DeviceBinner's
+    ``tpu_retry_attempts``-sized one — or the module default when
+    None, so transient failures recover in place on the worker). A
+    persistent failure surfaces as a ``PrefetchError`` naming the
+    failed chunk's index, every queued lookahead future is cancelled,
+    and the worker shuts down cleanly — the pipeline never
+    half-drains past a dead chunk."""
+    from ..utils import retry
     it = iter(thunks)
     with concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ingest-prefetch") as ex:
-        q: collections.deque = collections.deque()
+        q: collections.deque = collections.deque()  # (index, future)
+        submitted = 0
+
+        def submit() -> bool:
+            nonlocal submitted
+            try:
+                thunk = next(it)
+            except StopIteration:
+                return False
+            idx = submitted
+            submitted += 1
+            q.append((idx, ex.submit(
+                retry.call, thunk, what=f"{what} {idx}",
+                policy=policy)))
+            return True
+
         try:
             for _ in range(max(depth, 1)):
-                try:
-                    q.append(ex.submit(next(it)))
-                except StopIteration:
+                if not submit():
                     break
             while q:
-                fut = q.popleft()
+                idx, fut = q.popleft()
+                submit()
                 try:
-                    q.append(ex.submit(next(it)))
-                except StopIteration:
-                    pass
-                yield fut.result()
+                    yield fut.result()
+                except Exception as e:  # noqa: BLE001 — annotate+stop
+                    raise PrefetchError(
+                        f"{what} {idx} failed after retries "
+                        f"({type(e).__name__}: {e}); pipeline "
+                        f"cancelled") from e
         finally:
-            for f in q:
+            for _, f in q:
                 f.cancel()
 
 
@@ -241,6 +274,11 @@ class DeviceBinner:
         # lets sharded ingest align shards to the exact chunk the
         # grower will use instead of the 32k candidate superset
         self.hist_chunk = int(getattr(config, "tpu_hist_chunk", 0) or 0)
+        # transient-failure policy for this pipeline's prep + transfer
+        # seams: attempts come from the tpu_retry_attempts knob
+        from ..utils import retry
+        self.retry_policy = retry.RetryPolicy(
+            attempts=int(getattr(config, "tpu_retry_attempts", 4) or 4))
 
         # numerical tables: per-feature search range r, NaN bin, and the
         # bound keys padded to a power of two with the max key (never
@@ -371,6 +409,9 @@ class DeviceBinner:
         """Slice + key one chunk on the host (worker-thread half of the
         double buffer). Returns the transfer tuple, tail-padded to the
         fixed chunk shape so every chunk reuses one compiled kernel."""
+        from ..utils import faults
+        if faults.active():
+            faults.check("ingest.prep", context=f"{X.shape[0]} rows")
         with trace.span("ingest/prep_chunk", cat="ingest",
                         args={"rows": int(X.shape[0])}):
             return self._prep_chunk_inner(X)
@@ -413,11 +454,23 @@ class DeviceBinner:
         import jax
         (xa, xb, nan, cat_iv), k = prepped
         nbytes = sum(int(a.nbytes) for a in (xa, xb, nan, cat_iv))
+        from ..utils import faults, retry
+
+        def put():
+            # transient transfer failures (RESOURCE_EXHAUSTED on a busy
+            # tunnel, an injected ingest.device_put fault) retry with
+            # bounded backoff instead of killing the pipeline
+            if faults.active():
+                faults.check("ingest.device_put",
+                             context=f"{nbytes} bytes")
+            return jax.device_put((xa, xb, nan, cat_iv), device)
+
         with trace.span("ingest/chunk", cat="ingest",
                         args={"rows": int(k), "bytes": nbytes}):
             with timing.phase("binning/device_xfer"):
-                xa, xb, nan, cat_iv = jax.device_put(
-                    (xa, xb, nan, cat_iv), device)
+                xa, xb, nan, cat_iv = retry.call(
+                    put, what="ingest device_put",
+                    policy=self.retry_policy)
             obs.counter("ingest/h2d_bytes").add(nbytes)
             obs.counter("ingest/h2d_chunks").add(1)
             obs.counter("ingest/rows_device").add(k)
@@ -441,7 +494,9 @@ class DeviceBinner:
             return lambda: self._prep_chunk(X[r0:min(r0 + C, n)])
 
         outs = [self._submit(p)
-                for p in prefetch(thunk(r0) for r0 in starts)]
+                for p in prefetch((thunk(r0) for r0 in starts),
+                                  what="ingest chunk",
+                                  policy=self.retry_policy)]
         bins_t = outs[0] if len(outs) == 1 else jnp.concatenate(outs, 1)
         log.debug("device ingest: %d rows x %d features in %d chunk(s) "
                   "of %d rows", n, len(self.mappers), len(outs), C)
@@ -508,7 +563,9 @@ class DeviceBinner:
             return lambda: (d, self._prep_chunk(X[r0:r0 + rows]))
 
         per_dev = [[] for _ in range(D)]
-        for prepped in prefetch(thunk(t) for t in tasks):
+        for prepped in prefetch((thunk(t) for t in tasks),
+                                what="sharded ingest chunk",
+                                policy=self.retry_policy):
             d, p = prepped
             per_dev[d].append(self._submit(p, device=devs[d]))
 

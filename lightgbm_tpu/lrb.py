@@ -34,7 +34,7 @@ from . import capi
 from .obs import export as obs_export
 from .obs import registry as obs
 from .obs import trace
-from .utils import log
+from .utils import faults, log, retry
 
 HISTFEATURES = 50            # test.cpp:16
 NUM_FEATURES = HISTFEATURES + 3
@@ -59,6 +59,14 @@ TRAIN_PARAMS = {             # test.cpp:67-87
 }
 
 
+class WindowBudgetExceeded(RuntimeError):
+    """A window's training ran past the per-window wall budget — the
+    degrade path treats it like any other window-train failure
+    (serving continues on the previous model), and retry classifies
+    it non-transient (re-running the same window would blow the same
+    budget)."""
+
+
 class Window:
     """One window's trace + OPT bookkeeping (test.cpp globals)."""
 
@@ -79,7 +87,8 @@ class LrbDriver:
                  sample_size: int, cutoff: float, sampling: int,
                  result_file=sys.stdout, seed: int = 0,
                  extra_params: Optional[dict] = None,
-                 serve_batch: int = 64):
+                 serve_batch: int = 64,
+                 window_budget_s: Optional[float] = None):
         self.cache_size = cache_size
         self.window_size = window_size
         self.sample_size = sample_size
@@ -96,6 +105,12 @@ class LrbDriver:
                             (extra_params or {}).items()})
         trace.ensure_from_config(self.params)
         obs_export.ensure_from_config(self.params)
+        # fault-injection drills armed HERE so pre-booster points
+        # (dataset ingest) are covered from window 1 (idempotent:
+        # every window's booster init re-arms the same spec)
+        if self.params.get("tpu_faults"):
+            faults.configure(self.params["tpu_faults"],
+                             int(self.params.get("tpu_fault_seed", 0)))
         # driver-OWNED window-wall instrument: this run's quantile
         # summary must not mix in an earlier driver's windows (the
         # process-global twin below feeds the live exporter, which IS
@@ -112,6 +127,18 @@ class LrbDriver:
         self._serve_hist = obs.latency_histogram(
             "lrb/serve_latency_s", obs.MetricsRegistry())
         self.booster = None
+        # degrade-don't-die bookkeeping: a window whose training fails
+        # (exception, injected fault, or the per-window wall budget)
+        # is marked degraded and serving continues on the previous
+        # model; the staleness gauge counts windows since the last
+        # successful retrain — the number an operator alarms on
+        self.window_budget_s = (None if window_budget_s is None
+                                else float(window_budget_s))
+        self._windows_since_train = 0
+        self._trained_window = 0      # index of the serving model's window
+        self._retry_policy = retry.RetryPolicy(
+            attempts=int(self.params.get("tpu_retry_attempts", 4)),
+            seed=seed)
         self.window = Window()
         self.last_seen: Dict[Tuple[int, int], int] = {}
         # per-id inter-arrival history carried ACROSS windows is reset
@@ -119,6 +146,7 @@ class LrbDriver:
         # deriveFeatures) — mirrored here
         self.window_index = 0
         self.results: List[dict] = []
+        self.trace_lines_skipped = 0
 
     # -- request ingestion ---------------------------------------------------
 
@@ -163,7 +191,7 @@ class LrbDriver:
             rec["derive_s"] = round(time.monotonic() - t0, 3)
             rec["train_rows"] = len(labels)
             with trace.span("lrb/train", cat="window", args=wi):
-                rec.update(self._train_model(labels, X) or {})
+                rec.update(self._train_window(labels, X))
             rec.update(self._opt_ratios())
         wall = time.monotonic() - t_window
         rec["window_wall_s"] = round(wall, 3)
@@ -266,8 +294,59 @@ class LrbDriver:
 
     # -- train / evaluate (test.cpp:210-298) ---------------------------------
 
-    def _train_model(self, labels: np.ndarray,
-                     X: np.ndarray) -> Optional[dict]:
+    def _train_window(self, labels: np.ndarray, X: np.ndarray) -> dict:
+        """Degrade-don't-die wrapper around one window's training: a
+        transient failure retries with bounded backoff (utils/retry.py);
+        a persistent failure — exception, injected fault, or the
+        per-window wall budget — marks the window ``degraded`` and the
+        loop keeps serving the previous model instead of dying. The
+        staleness gauge and the windows_failed/degraded counters flow
+        to the live Prometheus export (obs/export.py)."""
+        out = None
+        reason = None
+        # ONE deadline for the whole window, shared across transient
+        # retries — a fresh clock per attempt would let one window
+        # stall the serving loop for attempts x budget
+        deadline = (time.monotonic() + self.window_budget_s
+                    if self.window_budget_s is not None else None)
+        try:
+            def attempt():
+                faults.check("lrb.window_train",
+                             context=f"window {self.window_index}")
+                return self._train_model(labels, X, deadline)
+            out = retry.call(
+                attempt, what=f"lrb window {self.window_index} train",
+                policy=self._retry_policy)
+        except Exception as e:      # noqa: BLE001 — degrade, don't die
+            obs.counter("lrb/windows_failed").add(1)
+            reason = f"{type(e).__name__}: {e}"
+            log.warning(
+                "window %d: training failed (%s); serving continues on "
+                "the model from window %d", self.window_index, reason,
+                self._trained_window)
+        rec: dict = {}
+        if out is not None:
+            self._windows_since_train = 0
+            self._trained_window = self.window_index
+            rec.update(out)
+        else:
+            if self.booster is not None or self._trained_window:
+                self._windows_since_train += 1
+            obs.counter("lrb/windows_degraded").add(1)
+            rec["degraded"] = True
+            rec["degrade_reason"] = reason or "degenerate_labels"
+        obs.gauge("lrb/model_staleness_windows").set(
+            self._windows_since_train)
+        rec["staleness_windows"] = self._windows_since_train
+        return rec
+
+    def degraded_windows(self) -> int:
+        """Windows that did not produce a fresh model (failed training,
+        blown budget, degenerate labels)."""
+        return sum(1 for r in self.results if r.get("degraded"))
+
+    def _train_model(self, labels: np.ndarray, X: np.ndarray,
+                     deadline: Optional[float] = None) -> Optional[dict]:
         if len(labels) == 0 or len(np.unique(labels)) < 2:
             log.warning("window %d: degenerate labels; keeping previous "
                         "model", self.window_index)
@@ -287,6 +366,14 @@ class LrbDriver:
         # the donated buffers instead of re-laying-out)
         booster = capi.LGBM_BoosterCreate(ds, self.params)
         for _ in range(int(self.params["num_iterations"])):
+            if deadline is not None and time.monotonic() > deadline:
+                # blown wall budget: the partial booster is DISCARDED
+                # (self.booster unchanged) — a half-trained model must
+                # never serve
+                raise WindowBudgetExceeded(
+                    f"window {self.window_index}: training exceeded "
+                    f"the {self.window_budget_s:g}s wall budget; "
+                    f"keeping the previous model")
             if capi.LGBM_BoosterUpdateOneIter(booster):
                 break
         s1 = step_cache.stats()
@@ -363,25 +450,50 @@ class LrbDriver:
 # trace IO + synthetic generator
 # ---------------------------------------------------------------------------
 
+_MALFORMED_WARN_CAP = 10       # per-line warnings before going quiet
+
+
 def run_trace_file(path: str, cache_size: int, window_size: int,
                    sample_size: int, cutoff: float, sampling: int,
                    result_file=sys.stdout,
-                   extra_params: Optional[dict] = None) -> LrbDriver:
+                   extra_params: Optional[dict] = None,
+                   window_budget_s: Optional[float] = None) -> LrbDriver:
+    """Drive the loop from a trace file. Malformed lines are SKIPPED
+    with a warning carrying the line number (capped at
+    ``_MALFORMED_WARN_CAP`` detail lines + a total-skipped summary) —
+    one bad record in a multi-day trace must not kill the run."""
     driver = LrbDriver(cache_size, window_size, sample_size, cutoff,
-                       sampling, result_file, extra_params=extra_params)
+                       sampling, result_file, extra_params=extra_params,
+                       window_budget_s=window_budget_s)
     seq = 0
+    skipped = 0
     with open(path) as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, 1):
             parts = line.split()
             if not parts:
                 continue
-            if len(parts) >= 4:
-                _, obj_id, size, cost = parts[:4]
-            else:
-                obj_id, size, cost = parts[:3]
+            try:
+                if len(parts) >= 4:
+                    _, obj_id, size, cost = parts[:4]
+                else:
+                    obj_id, size, cost = parts[:3]
+                req = (int(obj_id), int(float(size)), float(cost))
+            except (ValueError, IndexError) as e:
+                skipped += 1
+                if skipped <= _MALFORMED_WARN_CAP:
+                    log.warning("%s:%d: malformed trace line skipped "
+                                "(%s): %r", path, lineno, e,
+                                line.rstrip()[:80])
+                elif skipped == _MALFORMED_WARN_CAP + 1:
+                    log.warning("%s: further malformed-line warnings "
+                                "suppressed (summary at end)", path)
+                continue
             seq += 1
-            driver.process_request(seq, int(obj_id), int(float(size)),
-                                   float(cost))
+            driver.process_request(seq, *req)
+    driver.trace_lines_skipped = skipped
+    if skipped:
+        log.warning("%s: skipped %d malformed trace line(s) in total "
+                    "(%d served)", path, skipped, seq)
     return driver
 
 
@@ -418,6 +530,11 @@ def main(argv=None):
     if sq:
         print("serve_latency " + " ".join(f"{k}={1e3 * v:.3f}ms"
                                           for k, v in sq.items()),
+              file=out)
+    dw = driver.degraded_windows()
+    if dw:
+        print(f"degraded_windows={dw} "
+              f"model_staleness_windows={driver._windows_since_train}",
               file=out)
 
 

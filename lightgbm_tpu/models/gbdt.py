@@ -128,6 +128,10 @@ class GBDT:
         from ..obs import trace as obs_trace
         obs_trace.ensure_from_config(config)
         obs_export.ensure_from_config(config)
+        # deterministic fault injection (utils/faults.py): the
+        # tpu_faults knob arms the recovery drills' injection points
+        from ..utils import faults
+        faults.configure_from_config(config)
         self.objective = objective
         self.training_metrics = list(training_metrics)
         self.iter_ = 0
@@ -240,6 +244,11 @@ class GBDT:
         self._stop_check_interval = max(1, config.tpu_stop_check_interval)
         self._dispatch_sync_interval = config.tpu_dispatch_sync_interval
         self._stopped = False
+        # per-run eval-value history ((iteration, dataset, metric,
+        # value) tuples, global iteration numbering) — part of the
+        # checkpoint bundle so a resumed run's bookkeeping matches the
+        # uninterrupted run's (utils/checkpoint.py)
+        self._eval_history: List[tuple] = []
         # number of leading iteration-groups already verified productive,
         # so each periodic stop check scans only the new tail
         self._clean_groups = 0
@@ -1517,9 +1526,18 @@ class GBDT:
                         # FULL contents (a later append on top of the
                         # trim must not extend past stale positions)
                         sm = prev
-                    elif prev.extend(models[len(ref):]):
-                        sm = prev
-                        self._stacked_ref = models
+                    else:
+                        # copy-on-write: extend() re-bins the WHOLE
+                        # table layout in place, so it must never run
+                        # on the published object — a predict() in
+                        # flight outside this lock would read mixed
+                        # old/new tables mid-mutation. Extend a clone
+                        # and publish that instead; in-flight readers
+                        # keep the consistent original.
+                        cand = prev.clone_for_extend()
+                        if cand.extend(models[len(ref):]):
+                            sm = cand
+                            self._stacked_ref = models
             if sm is None:
                 from ..ops.stacked_predict import StackedModel
                 nf = self.max_feature_idx + 1
@@ -1804,12 +1822,21 @@ class GBDT:
 
     # -- CLI training driver (gbdt.cpp:245-263 GBDT::Train) ------------------
 
-    def train(self, snapshot_freq: int = -1, output_model: str = "") -> None:
+    def train(self, snapshot_freq: int = -1, output_model: str = "",
+              resume_from: str = "") -> None:
         """The application-side training loop: boosting iterations with
         per-iteration metric output (OutputMetric, gbdt.cpp:466-534),
         reference-style early stopping (EvalAndCheckEarlyStopping,
         gbdt.cpp:432-448: pop the last ``early_stopping_round``
         iterations on stop), and periodic snapshots.
+
+        Fault tolerance (utils/checkpoint.py): with
+        ``tpu_checkpoint_dir``/``tpu_checkpoint_freq`` set, the loop
+        periodically writes a resumable checkpoint bundle (atomic,
+        pruned to ``tpu_snapshot_keep``); ``resume_from`` (a bundle
+        path or a checkpoint directory — newest valid bundle wins)
+        restores a killed run and continues it BIT-IDENTICALLY to the
+        uninterrupted run, in the same global iteration numbering.
 
         Telemetry seam (obs/): every iteration is spanned by a
         RunRecorder (wall time, HBM, transfer-byte deltas, eval values;
@@ -1821,12 +1848,33 @@ class GBDT:
 
         from ..obs.profiler import ProfileWindow
         from ..obs.recorder import RunRecorder
+        from ..utils import faults
         cfg = self.config
         # best_score_[i][j] per (valid set, metric), in
         # bigger-is-better orientation
         self._best_score = [[-np.inf] * len(ms) for ms in self.valid_metrics]
         self._best_iter = [[0] * len(ms) for ms in self.valid_metrics]
         self._best_msg = [[""] * len(ms) for ms in self.valid_metrics]
+        start_iter = 0
+        if resume_from:
+            # restore overwrites the best-score lists initialized
+            # above, the RNG streams, the bagging mask and the device
+            # scores — the loop below then continues at start_iter + 1
+            # with the uninterrupted run's numbering. The checkpoint
+            # stores TOTAL tree groups; the loop counts ADDITIONAL
+            # rounds on top of any loaded input_model (gbdt.cpp:248),
+            # so a continued-training resume subtracts the base the
+            # input model contributed.
+            from ..utils import checkpoint as ckpt
+            pre_groups = (len(self.records)
+                          // max(self.num_tree_per_iteration, 1))
+            restored = ckpt.restore(self, ckpt.resolve_resume(
+                resume_from))
+            start_iter = restored - pre_groups
+            if start_iter < 0:
+                log.fatal(f"checkpoint at iteration {restored} predates "
+                          f"the loaded input_model ({pre_groups} "
+                          f"iterations) — it belongs to a different run")
         start_time = time.monotonic()
         is_finished = False
         recorder = RunRecorder(
@@ -1840,7 +1888,9 @@ class GBDT:
                   "wave_size": self._grower_cfg.wave_size,
                   "num_data": self._n,
                   "num_features": self.train_data.num_features,
-                  "num_class": self.num_class}).start()
+                  "num_class": self.num_class,
+                  **({"resumed_from_iteration": start_iter}
+                     if start_iter else {})}).start()
         self._recorder = recorder
         profile = ProfileWindow(cfg.tpu_profile_dir,
                                 cfg.tpu_profile_iters)
@@ -1916,7 +1966,10 @@ class GBDT:
         # align with the ADDITIONAL-round numbering used above
         base_groups = len(self.records) // self.num_tree_per_iteration
         try:
-            for add in range(cfg.num_iterations):
+            for add in range(start_iter, cfg.num_iterations):
+                if faults.active():
+                    # the kill-and-resume drills aim here (train.iter)
+                    faults.check("train.iter", context=add + 1)
                 profile.iter_begin(add + 1)
                 recorder.begin_iteration(add + 1)
                 is_finished = self.train_one_iter()
@@ -1958,8 +2011,15 @@ class GBDT:
                     # contain trees the pop then removes
                     if not is_finished:
                         is_finished = flush_pending()
-                    self.save_model_to_file(
-                        f"{output_model}.snapshot_iter_{add + 1}")
+                    self._write_snapshot(output_model, add + 1)
+                if (cfg.tpu_checkpoint_freq > 0 and cfg.tpu_checkpoint_dir
+                        and (add + 1) % cfg.tpu_checkpoint_freq == 0):
+                    # same flush-first rule as snapshots: the bundle
+                    # must not capture lookahead trees an early stop
+                    # is about to pop
+                    if not is_finished:
+                        is_finished = flush_pending()
+                    self.write_checkpoint(cfg.tpu_checkpoint_dir)
                 if is_finished:
                     break
             # flush the tail so the last iterations' metric lines (and a
@@ -1980,8 +2040,12 @@ class GBDT:
             K = self.num_tree_per_iteration
             # the stacked download is only paid when a report will
             # actually be written (it is a blocking device->host
-            # transfer — ~a full tunnel round-trip on RPC backends)
-            if cfg.tpu_run_report and len(self.records) > base_groups * K:
+            # transfer — ~a full tunnel round-trip on RPC backends).
+            # Resumed runs skip it: their iteration numbering continues
+            # at start_iter + 1 while the leaf lists would start at
+            # row 1, misaligning the report.
+            if cfg.tpu_run_report and start_iter == 0 \
+                    and len(self.records) > base_groups * K:
                 leaves, waves = self.leaves_and_waves(base_groups)
                 # cross-chip traffic: every root/wave histogram pass
                 # moves one [W, F, B, C] block through the psum
@@ -2012,6 +2076,47 @@ class GBDT:
             recorder.finish(extra={"aborted": True})
         timing.log_report("training phase timings "
                           "(serial_tree_learner.cpp:14-41 analog)")
+
+    def _write_snapshot(self, output_model: str, it: int) -> None:
+        """Periodic model snapshot (save_period): atomic write + prune
+        to the last ``tpu_snapshot_keep`` — a crash mid-write can no
+        longer leave a torn ``.snapshot_iter_N`` file, and old
+        snapshots no longer accumulate without bound. A failed write
+        warns and training continues."""
+        from ..utils.fileio import atomic_write, prune_numbered
+        path = f"{output_model}.snapshot_iter_{it}"
+        try:
+            with atomic_write(path) as fh:
+                fh.write(self.model_to_string())
+        except OSError as e:
+            log.warning("snapshot %s failed (%s); training continues",
+                        path, e)
+            return
+        prune_numbered(output_model, ".snapshot_iter_*",
+                       r"\.snapshot_iter_(\d+)$",
+                       self.config.tpu_snapshot_keep)
+
+    def write_checkpoint(self, directory: str) -> Optional[str]:
+        """Write a resumable checkpoint bundle (utils/checkpoint.py);
+        returns the path, or None on failure. Failures — disk full,
+        an injected ``checkpoint.write`` fault — warn and NEVER stop
+        or corrupt training: the atomic write leaves the previous
+        complete bundle intact. Public: engine.train's periodic
+        checkpoint wiring calls this too."""
+        from ..utils import checkpoint as ckpt
+        try:
+            return ckpt.save_checkpoint(
+                self, directory, keep=max(self.config.tpu_snapshot_keep,
+                                          1))
+        except Exception as e:      # noqa: BLE001 — durability aid:
+            # a checkpoint is insurance, never the failure itself
+            from ..obs import registry as obs
+            obs.counter("checkpoint/write_failures").add(1)
+            log.warning("checkpoint write to %s failed at iteration %d "
+                        "(%s: %s); training continues — the previous "
+                        "checkpoint is intact", directory,
+                        self.current_iteration, type(e).__name__, e)
+            return None
 
     def _eval_and_check_early_stopping(self, it: int, values=None,
                                        extra_drop: int = 0) -> bool:
@@ -2074,11 +2179,17 @@ class GBDT:
             out = (values.get(idx, []) if values is not None
                    else self.get_eval_at(idx))
             rec = getattr(self, "_recorder", None)
-            if rec is not None and out:
+            hist = getattr(self, "_eval_history", None)
+            if out and (rec is not None or hist is not None):
                 dname = ("training" if idx == 0
                          else self.valid_names[idx - 1])
                 for name, val, _ in out:
-                    rec.record_eval(it, dname, name, val)
+                    if rec is not None:
+                        rec.record_eval(it, dname, name, val)
+                    if hist is not None:
+                        # checkpoint-bundle eval history (global
+                        # iteration numbering, utils/checkpoint.py)
+                        hist.append((it, dname, name, float(val)))
             return out
 
         ret = ""
@@ -2198,10 +2309,21 @@ class GBDT:
         with open(filename, "w") as fh:
             fh.write(self.model_to_string(start_iteration, num_iteration))
 
-    def load_model_from_string(self, s: str) -> "GBDT":
-        """LoadModelFromString (gbdt_model_text.cpp:339-450)."""
+    def load_model_from_string(self, s: str, source: str = "") -> "GBDT":
+        """LoadModelFromString (gbdt_model_text.cpp:339-450).
+
+        Truncated or corrupt input fails with a ONE-LINE error naming
+        the source, what is malformed and the expected shape — never a
+        deep parse traceback (``source``: the file/context the text
+        came from, for the message)."""
         from ..objectives import parse_objective_from_model_string
+        where = source or "model text"
         lines = s.splitlines()
+        first = next((ln.strip() for ln in lines if ln.strip()), "")
+        if first != "tree":
+            log.fatal(f"{where}: not a LightGBM model (first line "
+                      f"{first[:40]!r}, expected 'tree'; model version "
+                      f"{K_MODEL_VERSION})")
         kv = {}
         i = 0
         while i < len(lines):
@@ -2236,16 +2358,29 @@ class GBDT:
         self.records = []
         self._bump_model_gen()
         cur: List[str] = []
+        seen_end = False
         for line in lines[i:]:
             t = line.strip()
             if t.startswith("Tree=") or t == "end of trees":
                 if cur:
-                    self.models.append(Tree.from_string("\n".join(cur)))
+                    try:
+                        self.models.append(
+                            Tree.from_string("\n".join(cur)))
+                    except Exception as e:   # noqa: BLE001 — one-line
+                        log.fatal(          # diagnosis, not a traceback
+                            f"{where}: malformed Tree="
+                            f"{len(self.models)} block "
+                            f"({type(e).__name__}: {e})")
                     cur = []
                 if t == "end of trees":
+                    seen_end = True
                     break
             elif t:
                 cur.append(t)
+        if not seen_end:
+            log.fatal(f"{where}: truncated model text — no 'end of "
+                      f"trees' terminator after {len(self.models)} "
+                      f"tree(s) (file cut off mid-write?)")
         self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
         self.shrinkage_rate = 1.0  # already folded into leaf values
         self._tree_shrinkage = [m.shrinkage if m.shrinkage else 1.0

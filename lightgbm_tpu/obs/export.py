@@ -208,6 +208,9 @@ class MetricsExporter:
             self.snapshots_written += 1   # HTTP-only mode still ticks
             return
         try:
+            from ..utils import faults
+            if faults.active():
+                faults.check("export.write")
             snap = self._snapshot()
             # .prom: atomic replace (scrapers must never read a torn
             # file); .jsonl: append-only time series
@@ -216,10 +219,11 @@ class MetricsExporter:
             with open(self.jsonl_path, "a") as fh:
                 fh.write(json.dumps(snap) + "\n")
             self.snapshots_written += 1
-        except OSError as e:
-            # export is an observability aid; a full disk must not
-            # take training down with it — but an operator watching
-            # for files that never appear deserves ONE diagnostic
+        except Exception as e:          # noqa: BLE001 — export is an
+            # observability aid; a full disk (or an injected
+            # export.write fault) must not take training down — but an
+            # operator watching for files that never appear deserves
+            # ONE diagnostic
             if not self._write_warned:
                 self._write_warned = True
                 from ..utils import log

@@ -284,6 +284,29 @@ class StackedModel:
 
     # -- incremental stacking -----------------------------------------------
 
+    def clone_for_extend(self) -> "StackedModel":
+        """A shallow copy whose ``extend()`` cannot perturb a reader
+        of the original — the copy-on-write half of the serving lock's
+        publish protocol (models/gbdt.py _stacked_model): a predict()
+        in flight on the ORIGINAL keeps a fully consistent model while
+        the training thread extends the clone and publishes it.
+
+        Only the containers ``extend`` mutates IN PLACE are duplicated
+        (threshold/category sets, the role masks, the device-stack and
+        dispatch memos — whose ``clear()`` would otherwise nuke the
+        original's too); the big host tables and binning arrays are
+        only ever REASSIGNED by extend, so sharing them until then is
+        safe."""
+        import copy
+        new = copy.copy(self)
+        new._thr_sets = [set(s) for s in self._thr_sets]
+        new._cat_sets = [set(s) for s in self._cat_sets]
+        new._zero_mt = self._zero_mt.copy()
+        new._is_cat = self._is_cat.copy()
+        new._dev_cache = dict(self._dev_cache)
+        new._dispatch_memo = dict(self._dispatch_memo)
+        return new
+
     def extend(self, new_trees: List) -> bool:
         """Append ``new_trees``, re-stacking ONLY the new tree chunk.
 

@@ -322,7 +322,13 @@ def build_train_step(*, grower, K: int, n_score: int, n_total: int,
                 new_out = jnp.where(rec.num_leaves > 1, new_out,
                                     rec.leaf_output)
                 rec = rec._replace(leaf_output=new_out)
-            # fold shrinkage (Tree::Shrinkage, gbdt.cpp:371)
+            # fold shrinkage (Tree::Shrinkage, gbdt.cpp:371).
+            # NOTE for resume/replay authors: XLA freely re-fuses this
+            # fold into the score gather-add (contraction skips the
+            # intermediate rounding), so the live score state is NOT
+            # reproducible by replaying the saved leaf outputs —
+            # checkpoint resume (utils/checkpoint.py) therefore saves
+            # the score buffers themselves instead of replaying trees.
             rec = rec._replace(
                 leaf_output=rec.leaf_output * shrink,
                 internal_value=rec.internal_value * shrink)

@@ -1,0 +1,444 @@
+"""Resumable training checkpoints: the model text PLUS the state the
+model text lacks.
+
+The ``snapshot_freq`` model snapshots are *predict*-grade: restarting
+from one loses the bagging RNG stream, the early-stopping bookkeeping
+and the eval history, so the restarted run diverges from the run that
+died. A *checkpoint bundle* captures everything ``GBDT.train`` needs to
+continue **bit-identically** (the repo's house parity bar — proven by
+tests/test_faults.py's kill-and-resume drill, serial and sharded):
+
+- the serialized model text (device TreeRecords are rebuilt from it on
+  resume, exactly like ``init_from_loaded``);
+- the live train/valid SCORE BUFFERS, verbatim, in a compressed
+  ``.scores.npz`` sidecar. This is the one piece of state that CANNOT
+  be re-derived: XLA fuses each iteration's shrinkage fold into the
+  score gather-add (contraction skips the stored outputs' intermediate
+  rounding), so replaying the saved trees lands within ~1 ulp of — but
+  not bit-equal to — the live scores, and ulp drift in scores becomes
+  ulp drift in every later tree. Saving the buffers makes resume
+  bit-identical by construction, on every backend;
+- the iteration index and every host RNG stream: bagging, feature
+  fraction, the GOSS hook RNG and DART's drop RNG (numpy Generator
+  ``bit_generator.state`` dicts — plain ints, JSON-safe);
+- the *current* bagging mask (``bagging_freq > 1`` reuses one draw for
+  several iterations; a resume inside the window must reuse the same
+  mask, not redraw);
+- early-stopping bookkeeping (best score/iteration/message per metric)
+  and the run's eval history, in the uninterrupted run's global
+  iteration numbering;
+- DART's tree-weight algebra and live shrinkage;
+- the training config fingerprint (mismatch = refusal with an
+  actionable message, not a silent divergence) and a step-cache
+  geometry summary for diagnostics.
+
+Format: one versioned JSON document per ``ckpt_iter_<N>.json`` plus a
+``ckpt_iter_<N>.scores.npz`` sidecar, both written via
+``utils/fileio.atomic_write`` — sidecar FIRST, bundle second, so the
+bundle is the commit point (a crash between the writes leaves an
+orphan sidecar, never a bundle pointing at a missing one) — and pruned
+to the last ``tpu_snapshot_keep``. Readers follow the run-report
+discipline
+(obs/recorder.py): schema/version are checked first and a future or
+corrupt layout is refused with a one-line error naming the file, what
+is malformed and the expected version.
+
+This module is a *friend* of models/gbdt.py — it reaches into the
+booster's private training state deliberately, so the whole
+gather/apply inventory lives in one reviewable place.
+"""
+from __future__ import annotations
+
+import base64
+import glob
+import hashlib
+import json
+import os
+import re
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from . import faults, log
+from .fileio import atomic_write, prune_numbered
+
+CHECKPOINT_SCHEMA = "lightgbm-tpu/checkpoint"
+CHECKPOINT_VERSION = 1
+
+_CKPT_RE = re.compile(r"ckpt_iter_(\d+)\.json$")
+
+# config fields excluded from the resume fingerprint: paths, telemetry
+# and the fault-tolerance knobs themselves — none shape the training
+# math, and a resumed run must be free to redirect its artifacts (or
+# extend num_iterations) without tripping the mismatch refusal
+VOLATILE_KNOBS = frozenset({
+    "config", "data", "valid", "task", "num_iterations",
+    "output_model", "snapshot_freq", "input_model", "output_result",
+    "verbosity",
+    "tpu_run_report", "tpu_trace", "tpu_trace_buffer",
+    "tpu_metrics_export", "tpu_metrics_interval_s", "tpu_metrics_port",
+    "tpu_profile_dir", "tpu_profile_iters", "tpu_watchdog_factor",
+    "tpu_autotune", "tpu_tuning_cache", "tpu_compile_cache_cpu",
+    "tpu_checkpoint_dir", "tpu_checkpoint_freq", "tpu_snapshot_keep",
+    "tpu_resume_from", "tpu_faults", "tpu_fault_seed",
+    "tpu_retry_attempts",
+})
+
+
+def config_fingerprint(config) -> str:
+    """Short sha256 over the training-relevant config fields (sorted
+    ``name=value`` lines, VOLATILE_KNOBS excluded)."""
+    import dataclasses
+    lines = []
+    for f in sorted(dataclasses.fields(config), key=lambda f: f.name):
+        if f.name in VOLATILE_KNOBS or f.name.startswith("_"):
+            continue
+        v = getattr(config, f.name)
+        if isinstance(v, list):
+            v = ",".join(str(x) for x in v)
+        lines.append(f"{f.name}={v}")
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()[:16]
+
+
+def checkpoint_path(directory: str, iteration: int) -> str:
+    return os.path.join(directory, f"ckpt_iter_{int(iteration)}.json")
+
+
+def scores_path(bundle_path: str) -> str:
+    """The score-buffer sidecar next to a bundle path."""
+    return bundle_path[: -len(".json")] + ".scores.npz" \
+        if bundle_path.endswith(".json") else bundle_path + ".scores.npz"
+
+
+def list_checkpoints(directory: str) -> List[Tuple[int, str]]:
+    """(iteration, path) pairs under ``directory``, newest first.
+    The directory is caller data — escaped, so a path containing
+    glob metacharacters still lists its own checkpoints."""
+    out = []
+    for p in glob.glob(os.path.join(glob.escape(directory),
+                                    "ckpt_iter_*.json")):
+        m = _CKPT_RE.search(os.path.basename(p))
+        if m:
+            out.append((int(m.group(1)), p))
+    return sorted(out, reverse=True)
+
+
+def prune_checkpoints(directory: str, keep: int) -> None:
+    """Delete all but the newest ``keep`` checkpoints, sidecars
+    included (best-effort; utils/fileio.prune_numbered — the same
+    helper the model-snapshot prune uses). Orphan sidecars — a crash
+    between the sidecar write and the bundle commit leaves a
+    ``.scores.npz`` with no bundle — are swept too: they are multi-MB
+    and no bundle will ever claim their iteration number again."""
+    prune_numbered(os.path.join(directory, ""), "ckpt_iter_*.json",
+                   r"ckpt_iter_(\d+)\.json$", keep,
+                   companions=lambda p: [scores_path(p)])
+    for p in glob.glob(os.path.join(glob.escape(directory),
+                                    "ckpt_iter_*.scores.npz")):
+        if not os.path.isfile(p[: -len(".scores.npz")] + ".json"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+# -- state gather/apply (the GBDT-private inventory) -------------------------
+
+def _rng_state(gen) -> Optional[dict]:
+    """numpy Generator -> its bit_generator state dict (JSON-safe
+    ints), or None for absent/stand-in generators."""
+    if gen is None or not hasattr(gen, "bit_generator"):
+        return None
+    return gen.bit_generator.state
+
+
+def _set_rng_state(gen, state) -> None:
+    if gen is not None and state is not None \
+            and hasattr(gen, "bit_generator"):
+        gen.bit_generator.state = state
+
+
+def _pack_mask(mask) -> Optional[dict]:
+    """0/1 float mask -> {n, b64-packed-bits}; None passes through."""
+    if mask is None:
+        return None
+    m = np.asarray(mask)
+    return {"n": int(m.shape[0]),
+            "bits": base64.b64encode(
+                np.packbits(m > 0.5).tobytes()).decode()}
+
+
+def _unpack_mask(rec) -> Optional[np.ndarray]:
+    if rec is None:
+        return None
+    n = int(rec["n"])
+    bits = np.frombuffer(base64.b64decode(rec["bits"]), np.uint8)
+    return np.unpackbits(bits)[:n].astype(np.float32)
+
+
+def gather_state(booster) -> dict:
+    """Everything past the model text that a bit-identical resume
+    needs (see module docstring for the inventory)."""
+    state = {
+        "rng": {
+            "bagging": _rng_state(getattr(booster, "_bagging_rng",
+                                          None)),
+            "feature": _rng_state(getattr(booster, "_feature_rng",
+                                          None)),
+            "hook": _rng_state(getattr(booster, "_hook_rng", None)),
+            "drop": _rng_state(getattr(booster, "_drop_rng", None)),
+        },
+        "bag_cache": _pack_mask(getattr(booster, "_bag_cache", None)),
+        "shrinkage_rate": float(booster.shrinkage_rate),
+        "boost_from_avg_done": list(
+            getattr(booster, "_boost_from_avg_done", [])),
+        "best_score": getattr(booster, "_best_score", None),
+        "best_iter": getattr(booster, "_best_iter", None),
+        "best_msg": getattr(booster, "_best_msg", None),
+        "eval_history": list(getattr(booster, "_eval_history", [])),
+    }
+    if hasattr(booster, "_tree_weight"):        # DART
+        state["dart"] = {
+            "tree_weight": [float(w) for w in booster._tree_weight],
+            "sum_weight": float(booster._sum_weight),
+        }
+    return state
+
+
+def apply_state(booster, state: dict) -> None:
+    rng = state.get("rng", {})
+    _set_rng_state(getattr(booster, "_bagging_rng", None),
+                   rng.get("bagging"))
+    _set_rng_state(getattr(booster, "_feature_rng", None),
+                   rng.get("feature"))
+    _set_rng_state(getattr(booster, "_hook_rng", None), rng.get("hook"))
+    _set_rng_state(getattr(booster, "_drop_rng", None), rng.get("drop"))
+    mask = _unpack_mask(state.get("bag_cache"))
+    if mask is not None:
+        booster._bag_cache = mask
+    booster.shrinkage_rate = float(state.get(
+        "shrinkage_rate", booster.shrinkage_rate))
+    done = state.get("boost_from_avg_done")
+    if done is not None and hasattr(booster, "_boost_from_avg_done"):
+        booster._boost_from_avg_done = [bool(x) for x in done]
+    for attr in ("best_score", "best_iter", "best_msg"):
+        if state.get(attr) is not None:
+            setattr(booster, "_" + attr, state[attr])
+    booster._eval_history = [tuple(x) for x in
+                             state.get("eval_history", [])]
+    dart = state.get("dart")
+    if dart is not None and hasattr(booster, "_tree_weight"):
+        booster._tree_weight = list(dart["tree_weight"])
+        booster._sum_weight = float(dart["sum_weight"])
+
+
+def _geometry_summary(booster) -> dict:
+    """The step-cache geometry this booster trains under — diagnostics
+    for 'why did my resumed run recompile' questions, not a resume
+    precondition (a hit on resume is expected, not required)."""
+    gcfg = getattr(booster, "_grower_cfg", None)
+    return {
+        "n_score": int(getattr(booster, "_n_score", 0)),
+        "n_total": int(getattr(booster, "_n_total", 0)),
+        "f_pad": int(getattr(booster, "_f_pad", 0)),
+        "num_bins": int(gcfg.num_bins) if gcfg else None,
+        "wave_size": int(gcfg.wave_size) if gcfg else None,
+        "learner": booster.learner_mode,
+        "devices": booster.num_devices,
+        "cache_eligible": bool(getattr(booster, "_cache_eligible",
+                                       False)),
+    }
+
+
+# -- bundle IO ---------------------------------------------------------------
+
+def save_checkpoint(booster, directory: str,
+                    keep: int = 3) -> Optional[str]:
+    """Write ``ckpt_iter_<N>.scores.npz`` then ``ckpt_iter_<N>.json``
+    (the bundle is the commit point) and prune to ``keep``; returns
+    the bundle path. Raises on failure — the caller (the training
+    loop) downgrades that to a warning so a full disk never takes
+    training down, and the atomic writes guarantee the previous
+    complete checkpoint survives."""
+    eff = booster._effective_num_models()
+    if eff != len(booster.models):
+        # trailing splitless trees: serialization would trim them while
+        # the scores still carry their contributions — and training is
+        # about to stop anyway (gbdt.cpp:393-409)
+        log.info("checkpoint skipped at iteration %d: model has "
+                 "trailing splitless trees (training is stopping)",
+                 booster.current_iteration)
+        return None
+    it = booster.current_iteration
+    path = checkpoint_path(directory, it)
+    bundle = {
+        "schema": CHECKPOINT_SCHEMA,
+        "version": CHECKPOINT_VERSION,
+        "created_unix": round(time.time(), 3),
+        "iteration": int(it),
+        "config_hash": config_fingerprint(booster.config),
+        "parameters": booster.config.to_string(),
+        "geometry": _geometry_summary(booster),
+        "state": gather_state(booster),
+        "scores_file": os.path.basename(scores_path(path)),
+        "model": booster.model_to_string(),
+    }
+    faults.check("checkpoint.write", context=f"iteration {it}")
+    arrays = {"scores": np.asarray(booster._scores)}
+    for vi, vs in enumerate(booster._valid_scores):
+        arrays[f"valid_{vi}"] = np.asarray(vs)
+    with atomic_write(scores_path(path), mode="wb") as fh:
+        np.savez_compressed(fh, **arrays)
+    with atomic_write(path) as fh:
+        json.dump(bundle, fh)
+    prune_checkpoints(directory, keep)
+    from ..obs import registry as obs
+    obs.counter("checkpoint/writes").add(1)
+    log.info("checkpoint written: %s (iteration %d, keep %d)",
+             path, it, keep)
+    return path
+
+
+def load_checkpoint(path: str) -> dict:
+    """Parse + validate one checkpoint bundle. Every failure is a
+    one-line ValueError naming the file, what is malformed, and the
+    version this reader expects — never a deep parse traceback."""
+    try:
+        with open(path) as fh:
+            bundle = json.load(fh)
+    except OSError as e:
+        raise ValueError(f"{path}: cannot read checkpoint ({e})") from e
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"{path}: corrupt checkpoint (truncated or not JSON: {e}); "
+            f"expected schema {CHECKPOINT_SCHEMA} v{CHECKPOINT_VERSION}"
+        ) from e
+    if not isinstance(bundle, dict):
+        raise ValueError(f"{path}: not a checkpoint bundle (top level "
+                         f"is {type(bundle).__name__}, expected an "
+                         f"object)")
+    if bundle.get("schema") != CHECKPOINT_SCHEMA:
+        raise ValueError(f"{path}: not a checkpoint bundle "
+                         f"(schema={bundle.get('schema')!r}; expected "
+                         f"{CHECKPOINT_SCHEMA})")
+    if bundle.get("version") != CHECKPOINT_VERSION:
+        raise ValueError(
+            f"{path}: checkpoint version {bundle.get('version')!r}, "
+            f"this reader wants {CHECKPOINT_VERSION} — refusing to "
+            f"misread a different layout")
+    for key in ("iteration", "model", "state", "config_hash",
+                "scores_file"):
+        if key not in bundle:
+            raise ValueError(f"{path}: malformed checkpoint (missing "
+                             f"{key!r}); expected schema "
+                             f"{CHECKPOINT_SCHEMA} v{CHECKPOINT_VERSION}")
+    sidecar = os.path.join(os.path.dirname(os.path.abspath(path)),
+                           str(bundle["scores_file"]))
+    if not os.path.isfile(sidecar):
+        raise ValueError(f"{path}: score sidecar "
+                         f"{bundle['scores_file']!r} is missing next to "
+                         f"the bundle (partial copy? crash between "
+                         f"writes?)")
+    bundle["_scores_path"] = sidecar
+    return bundle
+
+
+def resolve_resume(path_or_dir: str) -> dict:
+    """A checkpoint file loads directly; a directory resolves to its
+    NEWEST valid checkpoint — corrupt/newer-layout bundles are skipped
+    with a warning (a crash mid-write plus atomic_write means the
+    newest complete one is the right restart point)."""
+    if os.path.isdir(path_or_dir):
+        entries = list_checkpoints(path_or_dir)
+        if not entries:
+            raise ValueError(f"{path_or_dir}: no ckpt_iter_*.json "
+                             f"checkpoints to resume from")
+        errors = []
+        for it, p in entries:
+            try:
+                return load_checkpoint(p)
+            except ValueError as e:
+                errors.append(str(e))
+                log.warning("skipping unusable checkpoint: %s", e)
+        raise ValueError(f"{path_or_dir}: no usable checkpoint "
+                         f"({'; '.join(errors)})")
+    return load_checkpoint(path_or_dir)
+
+
+def restore(booster, bundle: dict) -> int:
+    """Apply a loaded bundle to an ``init()``-ed booster: refuse a
+    config mismatch, rebuild device TreeRecords from the model text,
+    load the train/valid score buffers VERBATIM from the sidecar (the
+    bit-identity guarantee — see module docstring), then restore the
+    host-side state. Returns the iteration to continue from."""
+    import jax.numpy as jnp
+
+    from ..models.gbdt import GBDT
+    from ..models.tree import record_arrays_from_tree
+    from ..ops.grower import TreeRecord
+
+    want = config_fingerprint(booster.config)
+    have = bundle.get("config_hash")
+    if have != want:
+        raise ValueError(
+            f"checkpoint was written under a different training config "
+            f"(hash {have} vs this run's {want}); resume requires "
+            f"identical training parameters — diff the checkpoint's "
+            f"'parameters' block against your run, or point "
+            f"tpu_checkpoint_dir at a fresh directory to start over")
+    scratch = GBDT()
+    scratch.load_model_from_string(bundle["model"],
+                                   source="checkpoint model text")
+    loaded = scratch.models
+    K = booster.num_tree_per_iteration
+    if scratch.num_tree_per_iteration != K:
+        raise ValueError(
+            f"checkpoint num_tree_per_iteration="
+            f"{scratch.num_tree_per_iteration} does not match this "
+            f"run's {K} (num_class/objective changed?)")
+
+    # score buffers: the live device state, not a replay
+    spath = bundle.get("_scores_path") or bundle.get("scores_file")
+    try:
+        with np.load(spath) as z:
+            scores = z["scores"]
+            valids = [z[f"valid_{vi}"] for vi in
+                      range(len(booster._valid_scores))]
+    except (OSError, KeyError, ValueError) as e:
+        raise ValueError(f"{spath}: unusable score sidecar "
+                         f"({type(e).__name__}: {e})") from e
+    want_shape = tuple(np.shape(np.asarray(booster._scores)))
+    if tuple(scores.shape) != want_shape:
+        raise ValueError(
+            f"{spath}: score buffer shape {tuple(scores.shape)} does "
+            f"not match this run's {want_shape} — same data and "
+            f"tpu_row_bucket policy are required to resume")
+    for vi, v in enumerate(valids):
+        have_v = tuple(np.shape(np.asarray(booster._valid_scores[vi])))
+        if tuple(v.shape) != have_v:
+            raise ValueError(
+                f"{spath}: valid_{vi} score shape {tuple(v.shape)} "
+                f"does not match this run's {have_v} — add the same "
+                f"valid sets before resuming")
+
+    L = booster._grower_cfg.num_leaves
+    td = booster.train_data
+    booster.models = list(loaded)
+    booster.records = []
+    booster._tree_shrinkage = [m.shrinkage if m.shrinkage else 1.0
+                               for m in loaded]
+    for tree in loaded:
+        arrs = record_arrays_from_tree(tree, td.real_to_inner,
+                                       td.mappers, L)
+        booster.records.append(TreeRecord(
+            **{k: jnp.asarray(v) for k, v in arrs.items()}))
+    booster._scores = booster._place_scores(scores)
+    booster._valid_scores = [booster._place_scores(v) for v in valids]
+    booster.iter_ = len(loaded) // K
+    booster._clean_groups = booster.iter_
+    booster._bump_model_gen()
+    apply_state(booster, bundle.get("state", {}))
+    log.info("resumed from checkpoint at iteration %d (%d trees, "
+             "config hash %s)", booster.iter_, len(loaded), want)
+    return booster.iter_

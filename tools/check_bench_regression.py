@@ -101,6 +101,33 @@ def check_schema(fresh: dict) -> List[str]:
     return problems
 
 
+def field_notes(doc: dict) -> List[str]:
+    """Informational notes for the fault-tolerance fields newer bench
+    JSONs may carry (``degraded_windows``, ``checkpoint`` meta) —
+    REPORTED, never a crash or a gate: a degraded serving window is an
+    operator signal, not a perf regression, and an old tool version
+    must keep working against new artifacts."""
+    notes = []
+    dw = doc.get("degraded_windows")
+    if dw is not None:
+        if isinstance(dw, (int, float)) and not isinstance(dw, bool):
+            if dw:
+                notes.append(f"{int(dw)} degraded window(s) reported "
+                             f"by this run")
+        else:
+            notes.append(f"degraded_windows present but "
+                         f"{type(dw).__name__}, not numeric — ignored")
+    ck = doc.get("checkpoint")
+    if ck is not None:
+        if isinstance(ck, dict):
+            keys = ", ".join(f"{k}={ck[k]}" for k in sorted(ck)[:4])
+            notes.append(f"checkpoint meta present ({keys})")
+        else:
+            notes.append(f"checkpoint meta present but "
+                         f"{type(ck).__name__}, not an object — ignored")
+    return notes
+
+
 def compare(fresh: dict, baseline: dict,
             throughput_tol: float = DEFAULT_THROUGHPUT_TOL,
             auc_tol: float = DEFAULT_AUC_TOL,
@@ -200,6 +227,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for p in problems:
             print(f"SCHEMA: {p}", file=sys.stderr)
         return 2
+    for note in field_notes(fresh):
+        print(f"NOTE: {note}")
     if args.schema_only:
         print(f"schema ok: {args.fresh} "
               f"({fresh['value']:g} {fresh['unit']})")
