@@ -42,6 +42,182 @@ def make_higgs_like(n_rows: int, n_features: int = 28, seed: int = 7):
 HOLDOUT_ROWS = 500_000
 
 
+def _lrb_probe_batch(rows: int) -> np.ndarray:
+    """A plausible LRB feature batch (inter-arrival gaps, log2 size,
+    log2 available bytes, cost) for the live-scoring thread — the
+    predictions' values don't matter, the serving path they exercise
+    does."""
+    from lightgbm_tpu.lrb import HISTFEATURES, NUM_FEATURES
+    r = np.random.default_rng(3)
+    X = np.zeros((rows, NUM_FEATURES), np.float64)
+    X[:, :8] = r.integers(1, 500, size=(rows, 8)).astype(np.float64)
+    X[:, HISTFEATURES] = np.round(
+        100.0 * np.log2(r.integers(64, 16384, rows)))
+    X[:, HISTFEATURES + 1] = round(100.0 * np.log2(1 << 16))
+    X[:, HISTFEATURES + 2] = 1.0
+    return X
+
+
+def lrb_stream_bench(args) -> dict:
+    """The streaming retrain-while-serve bench (ROADMAP item 3): the
+    SAME synthetic multi-window trace through the LRB loop twice in
+    one process — sequential then pipelined — at an LRB-realistic
+    request RATE, with a scorer thread firing ``predict_live``
+    micro-batches against the published model the whole time.
+
+    The feeder paces requests with a minimum inter-arrival gap (a
+    bounded-buffer upstream: a retrain stall pushes every later
+    arrival out — backpressure, not an infinite burst buffer), with
+    the rate auto-calibrated from an untimed warm pass so one window
+    of requests spans ~2.5x the window's warm training wall
+    (``--lrb-rate`` overrides; 0 = closed-loop, no pacing). Under
+    that load the comparison is structural, not scheduling luck: the
+    sequential loop stalls the stream for every window's whole
+    derive+train+evaluate wall, the pipelined loop absorbs training
+    into the stream's idle gaps — so pipelined sustains the offered
+    rate and wins end-to-end wall by ~the total training time.
+
+    Reported: end-to-end wall for both modes, sustained trace
+    requests/s (N / wall), serve p50/p99 split by whether a trainer
+    thread was mid-window when the probe fired (the during-retrain
+    tail is the number this workload exists to bound), and the
+    model-staleness lag."""
+    import io
+    import threading
+    import time as _time
+
+    from lightgbm_tpu import lrb
+    from lightgbm_tpu.obs import registry as obs_registry
+
+    windows = args.lrb_windows
+    rows = args.lrb_window_rows
+    sample = min(args.lrb_sample, rows)
+    iters = args.lrb_iters
+    if args.quick:
+        windows, rows = min(windows, 6), min(rows, 1024)
+        sample, iters = min(sample, 256), min(iters, 8)
+    reqs = list(lrb.synthetic_trace(windows * rows,
+                                    max(rows // 8, 50)))
+    base = {"num_iterations": iters, "verbose": "-1"}
+    probe = _lrb_probe_batch(args.lrb_serve_batch)
+
+    # untimed full-trace warm pass: pays the one-off per-geometry
+    # step/predict compiles (every window can land in its own shape
+    # bucket) so neither timed mode carries a cold tail the other
+    # skipped, AND yields the warm per-window training wall the
+    # request rate is calibrated from
+    warm = lrb.LrbDriver(1 << 16, rows, sample, 0.5, 1,
+                         result_file=io.StringIO(),
+                         extra_params={**base, "tpu_lrb_pipeline": 0},
+                         serve_batch=args.lrb_serve_batch)
+    for seq, oid, size, cost in reqs:
+        warm.process_request(seq, oid, size, cost)
+    warm.predict_live(probe)
+    train_walls = [r["train_s"] for r in warm.results
+                   if "train_s" in r]
+    warm.close()
+    rate = args.lrb_rate
+    if rate < 0:        # auto: one window of arrivals ~ 2.5x train
+        t_win = 2.5 * (np.median(train_walls) if train_walls else 0.5)
+        rate = rows / max(t_win, 1e-3)
+    # pacing in bursts of 16 keeps sleep syscalls off the per-request
+    # path; a stall rebases the clock (bounded buffer: missed arrival
+    # slots are lost, not replayed as an instant burst)
+    gap16 = 16.0 / rate if rate > 0 else 0.0
+
+    def run(mode):
+        drv = lrb.LrbDriver(1 << 16, rows, sample, 0.5, 1,
+                            result_file=io.StringIO(),
+                            extra_params={**base,
+                                          "tpu_lrb_pipeline": mode},
+                            serve_batch=args.lrb_serve_batch)
+        stop = threading.Event()
+        reg = obs_registry.MetricsRegistry()
+        hist_d = obs_registry.latency_histogram("serve_during", reg)
+        hist_b = obs_registry.latency_histogram("serve_between", reg)
+
+        def score_loop():
+            while not stop.is_set():
+                in_flight = drv.training_in_flight()
+                t0 = _time.monotonic()
+                out = drv.predict_live(probe)
+                dt = _time.monotonic() - t0
+                if out is None:         # no model published yet
+                    _time.sleep(0.002)
+                    continue
+                (hist_d if in_flight else hist_b).observe(dt)
+                _time.sleep(0.002)      # a bounded probe rate
+
+        th = threading.Thread(target=score_loop, name="lrb-scorer",
+                              daemon=True)
+        th.start()
+        t0 = _time.monotonic()
+        nxt = t0
+        for i, (seq, oid, size, cost) in enumerate(reqs):
+            if gap16 and i % 16 == 0:
+                nxt += gap16
+                delay = nxt - _time.monotonic()
+                if delay > 0:
+                    _time.sleep(delay)
+                else:
+                    nxt = _time.monotonic()
+            drv.process_request(seq, oid, size, cost)
+        drv.drain()
+        wall = _time.monotonic() - t0
+        stop.set()
+        th.join(timeout=10)
+        res = drv.results
+        degraded = drv.degraded_windows()
+        drv.close()
+        return res, wall, hist_d, hist_b, degraded
+
+    res_s, wall_s, _, _, deg_s = run(0)
+    res_p, wall_p, hist_d, hist_b, deg_p = run(1)
+    n_s = n_p = len(reqs)
+
+    parity_keys = ("eval_rows", "fp_rate", "fn_rate", "train_rows",
+                   "staleness_windows", "degraded", "degrade_reason")
+    mismatches = sum(1 for a, b in zip(res_s, res_p)
+                     for k in parity_keys if a.get(k) != b.get(k))
+    stale = [r.get("staleness_windows", 0) for r in res_p] or [0]
+
+    def q_ms(hist, q):
+        v = hist.percentile(q)
+        return None if v is None else round(1e3 * v, 3)
+
+    stream = {
+        "windows": windows, "window_rows": rows,
+        "sample_rows": sample, "iters": iters,
+        "offered_requests_per_s": round(rate, 1),
+        "wall_sequential_s": round(wall_s, 3),
+        "wall_pipelined_s": round(wall_p, 3),
+        "speedup": round(wall_s / max(wall_p, 1e-9), 3),
+        "requests_per_s": round(n_p / max(wall_p, 1e-9), 1),
+        "requests_per_s_sequential": round(n_s / max(wall_s, 1e-9), 1),
+        "serve_p50_during_retrain_ms": q_ms(hist_d, 0.5),
+        "serve_p99_during_retrain_ms": q_ms(hist_d, 0.99),
+        "serve_p50_between_ms": q_ms(hist_b, 0.5),
+        "serve_p99_between_ms": q_ms(hist_b, 0.99),
+        "requests_during_retrain": hist_d.count,
+        "staleness_p99_windows": round(
+            float(np.percentile(stale, 99)), 3),
+        "overlap_s_total": round(
+            sum(r.get("overlap_s", 0.0) for r in res_p), 3),
+        "degraded_windows": deg_p,
+        "degraded_windows_sequential": deg_s,
+        "result_parity_mismatches": mismatches,
+    }
+    print(f"# lrb-stream: {windows} windows x {rows} rows — wall "
+          f"seq {wall_s:.2f}s vs pipe {wall_p:.2f}s "
+          f"(speedup {stream['speedup']:.2f}x), "
+          f"{stream['requests_per_s']:.0f} requests/s, p99 during "
+          f"retrain {stream['serve_p99_during_retrain_ms']} ms "
+          f"({hist_d.count} reqs mid-retrain), staleness p99 "
+          f"{stream['staleness_p99_windows']} windows",
+          file=sys.stderr)
+    return stream
+
+
 def _auc(y, s):
     """Holdout AUC through the engine's own metric implementation."""
     from lightgbm_tpu.config import Config
@@ -93,9 +269,44 @@ def main():
                          "(tpu_run_report; .jsonl for line-delimited). "
                          "The JSON line's phase breakdown comes from "
                          "this report's phase table either way.")
+    ap.add_argument("--lrb-stream", action="store_true",
+                    help="run ONLY the streaming retrain-while-serve "
+                         "bench (lrb.py pipelined vs sequential on a "
+                         "synthetic multi-window trace, with a live "
+                         "scorer thread) and emit its JSON line — "
+                         "unit requests/s, details under 'lrb_stream'")
+    ap.add_argument("--no-lrb-stream", action="store_true",
+                    help="skip the compact lrb-stream section the "
+                         "standard bench appends to its JSON/report")
+    ap.add_argument("--lrb-windows", type=int, default=8)
+    ap.add_argument("--lrb-window-rows", type=int, default=4096)
+    ap.add_argument("--lrb-sample", type=int, default=512)
+    ap.add_argument("--lrb-iters", type=int, default=10)
+    ap.add_argument("--lrb-serve-batch", type=int, default=32)
+    ap.add_argument("--lrb-rate", type=float, default=-1.0,
+                    help="offered request rate (requests/s) for the "
+                         "lrb-stream feeder; -1 = auto-calibrate so "
+                         "one window of arrivals spans ~2.5x the warm "
+                         "training wall; 0 = closed loop (no pacing)")
     args = ap.parse_args()
     if args.quick:
         args.rows, args.iters, args.leaves = 65_536, 20, 63
+
+    if args.lrb_stream:
+        from lightgbm_tpu.ops import autotune as _autotune
+        _autotune.ensure_compile_cache()
+        stream = lrb_stream_bench(args)
+        print(json.dumps({
+            "lrb_stream": stream,
+            "metric": ("LRB streaming retrain-while-serve "
+                       f"({stream['windows']} windows x "
+                       f"{stream['window_rows']} rows, sample "
+                       f"{stream['sample_rows']}, "
+                       f"{stream['iters']} iters)"),
+            "value": stream["requests_per_s"],
+            "unit": "requests/s",
+        }))
+        return
 
     # persistent compile cache: the grower/predict kernels compile once
     # per machine instead of once per process (~30-60 s saved per run);
@@ -344,6 +555,14 @@ def main():
             k: pc1[k] - pc0[k] for k in ("hits", "misses", "stacks",
                                          "extends")}
 
+    # compact streaming retrain-while-serve section (bench hygiene:
+    # the trajectory point captures requests/s + during-retrain p99 +
+    # staleness, so BENCH_r0x diffs show the serving story too)
+    stream = None
+    if not args.no_lrb_stream:
+        stream = lrb_stream_bench(args)
+        recorder.meta["lrb_stream"] = stream
+
     recorder.meta["step_cache"] = step_cache.stats()
     recorder.meta["predict_cache"] = predict_cache.stats()
     report = recorder.finish(
@@ -367,6 +586,7 @@ def main():
         "predict_cache": predict_cache.stats(),
         "serve": serve,
         "retrain": retrain,
+        "lrb_stream": stream,
         "train_auc": round(float(auc), 5),
         "test_auc": round(float(test_auc), 5),
         # quantiles from the log-bucketed histogram, not a sample list:

@@ -56,12 +56,17 @@ class _DatasetHandle:
     creation is likewise deferred to ConstructFromSampleData)."""
 
     def __init__(self, X: np.ndarray, cfg: Config,
-                 reference: Optional["_DatasetHandle"] = None):
+                 reference: Optional["_DatasetHandle"] = None,
+                 ring=None):
         self.X = np.asarray(X, np.float64)
         self.cfg = cfg
         self.reference = reference
         self.fields: Dict[str, np.ndarray] = {}
         self._inner: Optional[TpuDataset] = None
+        # optional io/ingest.ChunkRing: a windowed retrain driver
+        # (lrb.py) keeps its training chunks device-resident across
+        # windows instead of re-uploading the padded chunk every time
+        self.ring = ring
 
     def construct(self) -> TpuDataset:
         if self._inner is None:
@@ -78,7 +83,8 @@ class _DatasetHandle:
                 ds = TpuDataset(self.cfg)
                 ds.construct_from_matrix(
                     self.X, meta, categorical=cats,
-                    mappers=getattr(self, "premade_mappers", None))
+                    mappers=getattr(self, "premade_mappers", None),
+                    ring=self.ring)
                 self._inner = ds
             names = getattr(self, "feature_names", None)
             if names:
@@ -146,10 +152,15 @@ def _mat_to_2d(data, nrow, ncol, is_row_major) -> np.ndarray:
 
 def LGBM_DatasetCreateFromMat(data, data_type=C_API_DTYPE_FLOAT64,
                               nrow=None, ncol=None, is_row_major=1,
-                              parameters="", reference=None):
-    """c_api.cpp:345 LGBM_DatasetCreateFromMat."""
+                              parameters="", reference=None,
+                              ring=None):
+    """c_api.cpp:345 LGBM_DatasetCreateFromMat. ``ring`` is a
+    Python-level extension (io/ingest.ChunkRing): windowed retrain
+    drivers pass their device-resident chunk ring so same-geometry
+    re-ingest uploads only live rows."""
     X = _mat_to_2d(data, nrow, ncol, is_row_major)
-    return _DatasetHandle(X, _params_to_config(parameters), reference)
+    return _DatasetHandle(X, _params_to_config(parameters), reference,
+                          ring=ring)
 
 
 def LGBM_DatasetCreateFromCSR(indptr, indptr_type, indices, data,

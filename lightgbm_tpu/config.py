@@ -480,6 +480,26 @@ class Config:
     # bounded exponential backoff + jitter) on the ingest/transfer
     # seams and the lrb window-train path
     tpu_retry_attempts: int = 4
+    # pipelined retrain-while-serve for the windowed LRB loop (lrb.py):
+    # window K's training runs on a background trainer thread while the
+    # main thread keeps ingesting window K+1's requests and deriving
+    # its features; the finished model is published with an atomic
+    # swap (a failed/degraded window publishes nothing — serving
+    # continues on the previous model). Per-window results are
+    # field-for-field identical to the sequential loop (model swaps
+    # take effect at window boundaries either way). -1 = auto (on);
+    # 0 = off (the strictly sequential derive->train->evaluate loop);
+    # 1 = force on.
+    tpu_lrb_pipeline: int = -1
+    # device-resident ingest chunk ring (io/ingest.py ChunkRing) for
+    # the per-window training matrix: each chunk slot's device buffers
+    # stay resident across windows and only the bucketed live-row
+    # region is re-uploaded (the chunk's pad tail — most of a
+    # sample-sized window's padded chunk — never crosses the wire
+    # again). Bit-identical bins; engages only when the streamed
+    # device ingest path is active. -1 = auto (on); 0 = off (full
+    # padded-chunk re-ingest every window); 1 = force on.
+    tpu_lrb_ring: int = -1
 
     def __post_init__(self):
         self._raw_params: Dict[str, str] = {}
@@ -684,6 +704,14 @@ class Config:
             log.warning("tpu_retry_attempts=%d is below the floor; "
                         "using 1 (no retries)", self.tpu_retry_attempts)
             self.tpu_retry_attempts = 1
+        if self.tpu_lrb_pipeline not in (-1, 0, 1):
+            log.warning("tpu_lrb_pipeline=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_lrb_pipeline)
+            self.tpu_lrb_pipeline = -1
+        if self.tpu_lrb_ring not in (-1, 0, 1):
+            log.warning("tpu_lrb_ring=%d is not one of -1/0/1; using "
+                        "-1 (auto)", self.tpu_lrb_ring)
+            self.tpu_lrb_ring = -1
         if self.tpu_metrics_interval_s <= 0:
             log.warning("tpu_metrics_interval_s=%g is not positive; "
                         "using 5.0", self.tpu_metrics_interval_s)

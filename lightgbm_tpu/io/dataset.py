@@ -169,7 +169,8 @@ class TpuDataset:
                               categorical: Sequence[int] = (),
                               reference: Optional["TpuDataset"] = None,
                               feature_names: Optional[List[str]] = None,
-                              mappers: Optional[List[BinMapper]] = None):
+                              mappers: Optional[List[BinMapper]] = None,
+                              ring=None):
         """Build bin mappers (or reuse reference's) and bin the matrix.
 
         Mirrors DatasetLoader::ConstructFromSampleData
@@ -212,7 +213,8 @@ class TpuDataset:
                 self._construct_mappers(X, set(categorical))
         with timing.phase("binning/bin_matrix") as ph:
             self._bin_matrix(X, efb_possible=(mappers is None
-                                              and reference is None))
+                                              and reference is None),
+                             ring=ring)
             if self.bins_t_dev is not None:
                 # device phase: sync at phase exit so queued kernel
                 # time lands here, not in a later unrelated phase
@@ -244,11 +246,14 @@ class TpuDataset:
         self.max_bin_global = max(
             (m.num_bin for m in self.mappers), default=1)
 
-    def _bin_matrix(self, X: np.ndarray, efb_possible: bool = False) -> None:
+    def _bin_matrix(self, X: np.ndarray, efb_possible: bool = False,
+                    ring=None) -> None:
         """Bin the whole matrix: streamed device ingest (io/ingest.py)
         when enabled and reproducible, else the host binner. Train sets
         of a row-sharding learner assemble the bins directly under the
-        mesh's NamedSharding (no single-device staging)."""
+        mesh's NamedSharding (no single-device staging). ``ring``
+        (io/ingest.py ChunkRing) lets a windowed retrain loop reuse the
+        previous construction's device-resident chunk buffers."""
         self.bins_t_dev = None
         self.bins_t_dev_pad = 0
         if self._device_ingest_ok(X, efb_possible):
@@ -275,11 +280,12 @@ class TpuDataset:
                              self.num_data, mesh.devices.size,
                              binner.chunk_rows)
                     return
-                self.bins_t_dev = binner.bin_matrix(X)
+                self.bins_t_dev = binner.bin_matrix(X, ring=ring)
                 self.bins = None
                 log.info("streamed device ingest: %d rows binned on "
-                         "device in %d-row chunks", self.num_data,
-                         binner.chunk_rows)
+                         "device in %d-row chunks%s", self.num_data,
+                         binner.chunk_rows,
+                         " (chunk ring)" if ring is not None else "")
                 return
         self.bins = self.bin_rows(X)
 

@@ -65,7 +65,8 @@ from __future__ import annotations
 
 import collections
 import concurrent.futures
-from typing import List, Sequence
+import threading
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -192,6 +193,86 @@ def prefetch(thunks, depth: int = 2, what: str = "chunk",
         finally:
             for _, f in q:
                 f.cancel()
+
+
+# -- device-resident chunk ring ----------------------------------------------
+
+# upload-region bucket floor: small windows re-pad to at most this many
+# rows, so the splice shapes (and their compiled programs) stay few
+_RING_UPLOAD_FLOOR = 256
+
+
+def ring_upload_rows(k: int, prev_valid: int, chunk_rows: int) -> int:
+    """Rows the ring path actually uploads for a chunk carrying ``k``
+    live rows over a slot whose previous occupant had ``prev_valid``:
+    the next power of two covering BOTH (stale rows of a larger
+    previous window must be overwritten with pad constants), floored
+    at ``_RING_UPLOAD_FLOOR`` and capped at the full chunk."""
+    u = max(k, prev_valid, 1)
+    b = _RING_UPLOAD_FLOOR
+    while b < u:
+        b *= 2
+    return min(b, chunk_rows)
+
+
+class ChunkRing:
+    """Bounded ring of device-RESIDENT raw ingest chunks, reused across
+    dataset constructions of the same chunk geometry — the lrb.py
+    sliding-window loop's training matrix.
+
+    The streamed ingest pipeline pads every chunk to the binner's fixed
+    ``chunk_rows`` on the HOST so all chunks share one compiled kernel;
+    for a sample-sized window that means most of the transfer is pad
+    bytes, re-uploaded every window. With a ring, each chunk slot keeps
+    its last assembled device transfer tuple resident; the next window
+    uploads only the bucketed live-row region (``ring_upload_rows``)
+    and the resident tail — whose rows are pad constants by the
+    invariant below — is spliced back on device. The raw value/key
+    planes are MAPPER-INDEPENDENT, so a fresh window's fresh bin
+    mappers bin the resident rows exactly as a full re-upload would:
+    training results are bit-identical.
+
+    Invariant: every resident array's rows at index >= its recorded
+    ``valid`` row count hold the host binner's pad constants (zeros;
+    -1 for the categorical plane). Maintained because each upload
+    region covers ``max(k, prev_valid)`` rows and carries those same
+    constants beyond row ``k``.
+
+    Slots are keyed by chunk index and guarded by the binner's chunk
+    geometry key — a dataset with a different chunk shape simply
+    misses. Thread-safe: the lrb trainer thread ingests while the main
+    thread may be building the next window's ring-less eval batches.
+    """
+
+    def __init__(self, capacity: int = 8):
+        self._lock = threading.Lock()
+        self._cap = max(int(capacity), 1)
+        self._slots: "collections.OrderedDict[int, tuple]" = \
+            collections.OrderedDict()
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def get(self, slot: int, geom_key) -> tuple:
+        """-> (resident arrays tuple or None, valid_rows)."""
+        with self._lock:
+            ent = self._slots.get(slot)
+            if ent is None or ent[0] != geom_key:
+                return None, 0
+            self._slots.move_to_end(slot)
+            return ent[1], ent[2]
+
+    def put(self, slot: int, geom_key, arrays, valid: int) -> None:
+        with self._lock:
+            self._slots[slot] = (geom_key, arrays, int(valid))
+            self._slots.move_to_end(slot)
+            while len(self._slots) > self._cap:
+                self._slots.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slots.clear()
 
 
 # -- sortable-integer float keys --------------------------------------------
@@ -405,19 +486,23 @@ class DeviceBinner:
 
     # -- host-side chunk prep ------------------------------------------------
 
-    def _prep_chunk(self, X: np.ndarray):
+    def _prep_chunk(self, X: np.ndarray, pad_to: Optional[int] = None):
         """Slice + key one chunk on the host (worker-thread half of the
         double buffer). Returns the transfer tuple, tail-padded to the
-        fixed chunk shape so every chunk reuses one compiled kernel."""
+        fixed chunk shape so every chunk reuses one compiled kernel —
+        or to ``pad_to`` rows (the ring path, which splices the
+        remaining pad tail from the device-resident slot instead of
+        re-uploading it)."""
         from ..utils import faults
         if faults.active():
             faults.check("ingest.prep", context=f"{X.shape[0]} rows")
         with trace.span("ingest/prep_chunk", cat="ingest",
                         args={"rows": int(X.shape[0])}):
-            return self._prep_chunk_inner(X)
+            return self._prep_chunk_inner(X, pad_to)
 
-    def _prep_chunk_inner(self, X: np.ndarray):
-        C = self.chunk_rows
+    def _prep_chunk_inner(self, X: np.ndarray,
+                          pad_to: Optional[int] = None):
+        C = pad_to if pad_to is not None else self.chunk_rows
         k = X.shape[0]
         pad = C - k
         Xn = X[:, self.num_cols] if len(self.num_cols) else \
@@ -446,14 +531,18 @@ class DeviceBinner:
             cat_iv = np.zeros((C, 0), np.int32)
         return (xa, xb, nan, cat_iv), k
 
-    def _submit(self, prepped, device=None):
+    def _submit(self, prepped, device=None, assemble=None):
         """Main-thread half: async transfer + kernel dispatch. Returns
         the [F, k] device block (tail chunks sliced to their true
         rows). ``device`` pins the transfer AND the kernel to one mesh
-        device (sharded ingest); None = the default device."""
+        device (sharded ingest); None = the default device.
+        ``assemble`` (the ring path) maps the transferred arrays to
+        the full-chunk tuple the kernel consumes — ONE copy of the
+        transfer protocol (fault point, retry, span, h2d ledger)
+        serves both paths."""
         import jax
-        (xa, xb, nan, cat_iv), k = prepped
-        nbytes = sum(int(a.nbytes) for a in (xa, xb, nan, cat_iv))
+        arrs, k = prepped
+        nbytes = sum(int(a.nbytes) for a in arrs)
         from ..utils import faults, retry
 
         def put():
@@ -463,31 +552,48 @@ class DeviceBinner:
             if faults.active():
                 faults.check("ingest.device_put",
                              context=f"{nbytes} bytes")
-            return jax.device_put((xa, xb, nan, cat_iv), device)
+            return jax.device_put(arrs, device)
 
-        with trace.span("ingest/chunk", cat="ingest",
-                        args={"rows": int(k), "bytes": nbytes}):
+        span_args = {"rows": int(k), "bytes": nbytes}
+        if assemble is not None:
+            span_args["ring"] = True
+        with trace.span("ingest/chunk", cat="ingest", args=span_args):
             with timing.phase("binning/device_xfer"):
-                xa, xb, nan, cat_iv = retry.call(
+                arrs = retry.call(
                     put, what="ingest device_put",
                     policy=self.retry_policy)
             obs.counter("ingest/h2d_bytes").add(nbytes)
             obs.counter("ingest/h2d_chunks").add(1)
             obs.counter("ingest/rows_device").add(k)
-            out = self._chunk_fn(xa, xb, nan, cat_iv)
+            if assemble is not None:
+                arrs = assemble(arrs)
+            out = self._chunk_fn(*arrs)
         if k < self.chunk_rows:
             out = out[:, :k]
         return out
 
     # -- drivers -------------------------------------------------------------
 
-    def bin_matrix(self, X: np.ndarray):
+    def bin_matrix(self, X: np.ndarray,
+                   ring: Optional[ChunkRing] = None):
         """Whole in-memory matrix -> [F, N] device bins with the
         double-buffered pipeline (worker preps chunk k+1 while chunk
-        k's transfer + kernel are in flight)."""
+        k's transfer + kernel are in flight). With a ``ring``, chunk
+        slots reuse the device-resident buffers of the previous
+        same-geometry construction and only the bucketed live-row
+        region crosses the wire (see ChunkRing)."""
         import jax.numpy as jnp
         n = X.shape[0]
         C = self.chunk_rows
+        if ring is not None:
+            if -(-n // C) <= ring.capacity:
+                return self._bin_matrix_ringed(X, ring)
+            # a matrix wider than the ring would evict every slot
+            # before its next-window reuse: every get would miss while
+            # every put still pins a full resident chunk — pure
+            # overhead, so take the plain path instead
+            log.debug("chunk ring bypassed: %d chunks exceed ring "
+                      "capacity %d", -(-n // C), ring.capacity)
         starts = list(range(0, n, C))
 
         def thunk(r0):
@@ -500,6 +606,96 @@ class DeviceBinner:
         bins_t = outs[0] if len(outs) == 1 else jnp.concatenate(outs, 1)
         log.debug("device ingest: %d rows x %d features in %d chunk(s) "
                   "of %d rows", n, len(self.mappers), len(outs), C)
+        return bins_t
+
+    # -- ring path ------------------------------------------------------------
+
+    def _geom_key(self) -> tuple:
+        """Chunk geometry the ring's resident buffers are only valid
+        for: the raw-value planes depend on the source columns, dtype
+        scheme and fixed chunk rows — NOT on the bin mappers, which is
+        exactly why a fresh window's fresh mappers can bin resident
+        rows bit-identically."""
+        return (self.chunk_rows, self.f32_input,
+                tuple(int(c) for c in self.num_cols),
+                tuple(int(c) for c in self.cat_cols))
+
+    def _ring_tail(self, idx: int, rows: int, like) -> "object":
+        """Device-created pad tail for a cold slot: the host binner's
+        pad constants (zeros; -1 for the categorical plane), never
+        crossing the wire."""
+        import jax.numpy as jnp
+        fill = -1 if idx == 3 else 0
+        return jnp.full((rows,) + tuple(like.shape[1:]), fill,
+                        like.dtype)
+
+    def _ring_assemble(self, up, resident, U: int):
+        """Splice the uploaded [U, ...] row blocks onto each resident
+        slot's pad tail -> full chunk_rows arrays (on device)."""
+        import jax.numpy as jnp
+        C = self.chunk_rows
+        full = []
+        for i, a in enumerate(up):
+            if getattr(a, "ndim", 0) != 2 or a.shape[0] != U or U >= C:
+                # placeholders ((0,)-shaped f32-mode planes) and
+                # full-width uploads pass through
+                full.append(a)
+                continue
+            tail = (resident[i][U:] if resident is not None
+                    else self._ring_tail(i, C - U, a))
+            full.append(jnp.concatenate([a, tail], axis=0))
+        return tuple(full)
+
+    def _bin_matrix_ringed(self, X: np.ndarray, ring: ChunkRing):
+        import jax.numpy as jnp
+        n = X.shape[0]
+        C = self.chunk_rows
+        geom = self._geom_key()
+        plans = []                      # (slot, live rows, U, resident)
+        for slot, r0 in enumerate(range(0, n, C)):
+            k = min(C, n - r0)
+            resident, valid = ring.get(slot, geom)
+            plans.append((slot, r0, k,
+                          ring_upload_rows(k, valid, C), resident))
+
+        def thunk(p):
+            slot, r0, k, U, _ = p
+            return lambda: (p, self._prep_chunk(X[r0:r0 + k], pad_to=U))
+
+        outs = []
+        saved = 0
+        for p, prepped in prefetch((thunk(p) for p in plans),
+                                   what="ingest ring chunk",
+                                   policy=self.retry_policy):
+            slot, _, k, U, resident = p
+
+            def assemble(up, slot=slot, resident=resident, U=U, k=k):
+                full = self._ring_assemble(up, resident, U)
+                if U < C:
+                    # full-width uploads are NOT stored: pinning a
+                    # whole chunk buys nothing (the next partial
+                    # window's cold path makes its pad tail on device)
+                    # and would force that window to re-cover the full
+                    # previous valid extent
+                    ring.put(slot, geom, full, valid=k)
+                return full
+
+            outs.append(self._submit(prepped, assemble=assemble))
+            obs.counter("ingest/ring_hits"
+                        if resident is not None
+                        else "ingest/ring_misses").add(1)
+            # bytes the full-pad path would have shipped for the rows
+            # the ring kept resident (or created on device)
+            up, _k = prepped
+            saved += sum((C - U) * int(a.nbytes) // max(a.shape[0], 1)
+                         for a in up if getattr(a, "ndim", 0) == 2
+                         and a.shape[0] == U and U < C)
+        if saved:
+            obs.counter("ingest/ring_saved_bytes").add(saved)
+        bins_t = outs[0] if len(outs) == 1 else jnp.concatenate(outs, 1)
+        log.debug("device ingest (ring): %d rows x %d features in %d "
+                  "chunk(s) of %d rows", n, len(self.mappers),
+                  len(outs), C)
         return bins_t
 
     def bin_matrix_sharded(self, X: np.ndarray, mesh):

@@ -16,6 +16,22 @@ per fixed-size window of (id, size, cost) cache requests,
    false-positive / false-negative rates at ``cutoff`` plus the OPT
    object/byte hit ratios (evaluateModel, test.cpp:210-238).
 
+Pipelined retrain-while-serve (``tpu_lrb_pipeline``, default on): the
+reference loop is strictly sequential — every window blocks the serving
+path for derive -> train -> evaluate. Here window K's training runs on a
+background trainer thread while the main thread keeps ingesting window
+K+1's requests, OPT-labeling them and deriving their features; the
+finished model is published with an atomic swap (pre-warmed through
+``GBDT.prepare_serving``), and a failed/degraded window publishes
+nothing — the swap simply never happens and serving continues on the
+previous model. The trainer is joined at the next window boundary
+BEFORE that window's evaluation, so per-window results are
+field-for-field identical to the sequential loop (model swaps take
+effect at window boundaries either way). The per-request hot loops
+(feature derivation's gap walk, the OPT admission scan) are vectorized
+group-by-object numpy — the scalar reference transliterations are kept
+as ``*_scalar`` test oracles, bit-identical by tests/test_lrb_pipeline.
+
 Run: ``python -m lightgbm_tpu.lrb <trace> <cacheSize> <windowSize>
 <sampleSize> <cutoff> <sampling> [result_file]`` — the same argv as the
 reference binary. ``trace`` rows: ``seq id size cost`` (or
@@ -23,7 +39,9 @@ reference binary. ``trace`` rows: ``seq id size cost`` (or
 """
 from __future__ import annotations
 
+import concurrent.futures
 import sys
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -78,10 +96,13 @@ class Window:
         self.has_next: List[bool] = []
         self.volume: List[int] = []
         self.byte_sum = 0
+        self._feat_ctx = None   # sampling-independent derive arrays
 
 
 class LrbDriver:
-    """The windowed retraining loop (test.cpp:300-341 processRequest)."""
+    """The windowed retraining loop (test.cpp:300-341 processRequest),
+    pipelined: training runs behind the serving path (see module
+    docstring)."""
 
     def __init__(self, cache_size: int, window_size: int,
                  sample_size: int, cutoff: float, sampling: int,
@@ -117,16 +138,20 @@ class LrbDriver:
         # cumulative by design, like every registry counter)
         self._wall_hist = obs.latency_histogram(
             "lrb/window_wall_s", obs.MetricsRegistry())
-        # serving-path instrument: every evaluation scores the window's
-        # requests against the PREVIOUS window's model in serve-bucket
-        # micro-batches (the retrain-while-serve shape, ROADMAP item
-        # 3); each call's wall lands here as one request latency.
+        # serving-path instruments: every evaluation scores the
+        # window's requests against the PREVIOUS window's model in
+        # serve-bucket micro-batches (ops/predict_cache.py).
+        # serve_latency is PER-REQUEST — a k-row micro-batch whose wall
+        # is dt contributes k request latencies of dt (every request in
+        # it waited the batch out), so p99 means what an operator
+        # thinks it means; serve_batch keeps the per-CALL wall.
         # Driver-owned for the same reason as _wall_hist; the global
-        # twin feeds the live exporter.
+        # twins feed the live exporter.
         self.serve_batch = max(int(serve_batch), 1)
         self._serve_hist = obs.latency_histogram(
             "lrb/serve_latency_s", obs.MetricsRegistry())
-        self.booster = None
+        self._serve_batch_hist = obs.latency_histogram(
+            "lrb/serve_batch_s", obs.MetricsRegistry())
         # degrade-don't-die bookkeeping: a window whose training fails
         # (exception, injected fault, or the per-window wall budget)
         # is marked degraded and serving continues on the previous
@@ -139,14 +164,101 @@ class LrbDriver:
         self._retry_policy = retry.RetryPolicy(
             attempts=int(self.params.get("tpu_retry_attempts", 4)),
             seed=seed)
+        # retrain-while-serve pipeline (tpu_lrb_pipeline: -1 auto=on /
+        # 0 sequential / 1 on): one trainer thread, one window in
+        # flight, atomic publish under the swap lock
+        self.pipelined = int(self.params.get("tpu_lrb_pipeline",
+                                             -1)) != 0
+        self._swap_lock = threading.Lock()
+        # serializes the pending-window takeover: results/booster
+        # drain from any thread, and two concurrent drains must not
+        # both run the join body (double-counted staleness, duplicate
+        # result lines)
+        self._join_lock = threading.Lock()
+        self._serving = None          # published booster handle
+        self._pending: Optional[dict] = None
+        self._executor: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        self._eval_executor: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
+        # test seam for liveness drills: when a test installs an Event
+        # as _train_gate, the trainer signals _train_started and parks
+        # on the gate — the main thread can then prove serving stays
+        # live while a training is provably mid-window
+        self._train_gate: Optional[threading.Event] = None
+        self._train_started = threading.Event()
+        self._ring = self._make_ring()
         self.window = Window()
         self.last_seen: Dict[Tuple[int, int], int] = {}
         # per-id inter-arrival history carried ACROSS windows is reset
         # with the window in the reference (statistics is local to
         # deriveFeatures) — mirrored here
         self.window_index = 0
-        self.results: List[dict] = []
+        self._results: List[dict] = []
         self.trace_lines_skipped = 0
+
+    def _make_ring(self):
+        """Device-resident ingest chunk ring (io/ingest.py ChunkRing)
+        for the per-window training matrix — every window's chunk
+        slots reuse the previous window's resident device buffers and
+        upload only the bucketed live-row region. tpu_lrb_ring: -1
+        auto (on when the streamed device ingest path is active), 0
+        off, 1 force."""
+        rk = int(self.params.get("tpu_lrb_ring", -1))
+        if rk == 0:
+            return None
+        from .io import ingest
+        if rk == -1:
+            from .config import Config
+            cfg = Config()
+            cfg.set({k: str(v) for k, v in self.params.items()})
+            if not ingest.ingest_enabled(cfg):
+                return None
+        return ingest.ChunkRing()
+
+    # -- published-model access ----------------------------------------------
+
+    @property
+    def booster(self):
+        """The serving model's booster handle (None until a window
+        trains successfully). Reading it drains any in-flight window
+        training first, so callers always observe the final state of
+        every completed window."""
+        self.drain()
+        with self._swap_lock:
+            return self._serving
+
+    @booster.setter
+    def booster(self, handle) -> None:
+        with self._swap_lock:
+            self._serving = handle
+
+    @property
+    def results(self) -> List[dict]:
+        """Per-window result records; drains the pipeline so the last
+        window's training outcome is folded in."""
+        self.drain()
+        return self._results
+
+    def predict_live(self, X: np.ndarray) -> Optional[np.ndarray]:
+        """Score a request batch against the CURRENTLY published model
+        — the live serving entry a request stream hits while the
+        trainer thread may be mid-window. Thread-safe: the handle is
+        snapshotted under the swap lock and a concurrent publish never
+        mutates an already-published booster (every window trains a
+        fresh one). None before the first successful window."""
+        with self._swap_lock:
+            h = self._serving
+        if h is None:
+            return None
+        return np.asarray(capi.LGBM_BoosterPredictForMat(
+            h, X, predict_type=capi.C_API_PREDICT_NORMAL))
+
+    def training_in_flight(self) -> bool:
+        """True while the trainer thread holds a window (the
+        during-retrain tag of the streaming bench)."""
+        p = self._pending
+        return bool(p is not None and not p["future"].done())
 
     # -- request ingestion ---------------------------------------------------
 
@@ -171,6 +283,16 @@ class LrbDriver:
 
     def _process_window(self) -> None:
         self.window_index += 1
+        if self.pipelined:
+            self._process_window_pipelined()
+        else:
+            self._process_window_sequential()
+        self.window = Window()
+        self.last_seen.clear()
+
+    def _process_window_sequential(self) -> None:
+        """The reference's strictly serial boundary: evaluate ->
+        derive -> train, everything on the calling thread."""
         t_window = time.monotonic()
         wi = {"window": self.window_index}
         rec = {"window": self.window_index}
@@ -180,10 +302,11 @@ class LrbDriver:
             # seconds land in the results AND as spans on the trace
             # timeline (evaluate derives the NEXT window's features on
             # the previous model — the serving half of the loop)
-            if self.booster is not None:
+            if self._serving is not None:
                 t0 = time.monotonic()
                 with trace.span("lrb/evaluate", cat="window", args=wi):
-                    rec.update(self._evaluate_model())
+                    labels, X = self._derive_features(0)
+                    rec.update(self._score_window(labels, X))
                 rec["evaluate_s"] = round(time.monotonic() - t0, 3)
             t0 = time.monotonic()
             with trace.span("lrb/derive", cat="window", args=wi):
@@ -191,29 +314,98 @@ class LrbDriver:
             rec["derive_s"] = round(time.monotonic() - t0, 3)
             rec["train_rows"] = len(labels)
             with trace.span("lrb/train", cat="window", args=wi):
-                rec.update(self._train_window(labels, X))
+                stats, handle, reason = self._attempt_window_train(
+                    labels, X, self.window_index)
+                if handle is not None:
+                    self.booster = handle
+                self._apply_train_outcome(rec, stats, reason)
             rec.update(self._opt_ratios())
-        wall = time.monotonic() - t_window
-        rec["window_wall_s"] = round(wall, 3)
-        # quantile-grade window-wall latency (obs/registry.py preset):
-        # the exporter publishes p50/p95/p99 live, the final summary
-        # prints them — the instrument ROADMAP §3's streaming bench
-        # will judge retrain-while-serve against
-        self._wall_hist.observe(wall)
-        obs.latency_histogram("lrb/window_wall_s").observe(wall)
-        self.results.append(rec)
-        print(f"window {self.window_index}: "
-              + " ".join(f"{k}={v}" for k, v in rec.items()),
-              file=self.out)
-        # keep the on-disk trace current: a live loop can be inspected
-        # mid-run, and a killed run keeps its last window
-        trace.write()
-        self.window = Window()
-        self.last_seen.clear()
+        self._results.append(rec)
+        self._finish_window(rec, time.monotonic() - t_window)
+
+    def _process_window_pipelined(self) -> None:
+        """The retrain-while-serve boundary. Everything that does NOT
+        need the incoming model runs while the PREVIOUS window may
+        still be training on the trainer thread: OPT labels, the
+        train-sample features and the eval batch's features (all
+        model-independent). The join lands right before the model
+        snapshot, so the snapshot is exactly the model the sequential
+        loop would evaluate against; THIS window's training is then
+        handed to the trainer and the evaluation — the expensive
+        serving loop — runs over the trainer's shoulder against the
+        snapshot (a mid-scoring publish of this window's own model
+        cannot leak into its evaluation). Field-for-field, the record
+        matches the sequential loop's."""
+        t_window = time.monotonic()
+        wi = {"window": self.window_index}
+        rec = {"window": self.window_index}
+        with trace.span("window", cat="window", args=wi):
+            self._calculate_opt()
+            t0 = time.monotonic()
+            with trace.span("lrb/derive", cat="window", args=wi):
+                labels, X = self._derive_features(self.sampling)
+            rec["derive_s"] = round(time.monotonic() - t0, 3)
+            ev = None
+            ev_derive_s = 0.0
+            if self._serving is not None or self._pending is not None:
+                # the eval batch's features are model-independent —
+                # derive them NOW, over the trainer's shoulder
+                t0 = time.monotonic()
+                with trace.span("lrb/derive_eval", cat="window",
+                                args=wi):
+                    ev = self._derive_features(0)
+                ev_derive_s = time.monotonic() - t0
+            self._join_pending()
+            with self._swap_lock:
+                h = self._serving       # swap-at-boundary snapshot
+            rec["train_rows"] = len(labels)
+            rec.update(self._opt_ratios())
+            self._submit_train(labels, X, rec, t_window)
+            if h is not None and ev is not None:
+                # the evaluation — the expensive serving loop — runs
+                # on its own server thread, concurrent with BOTH this
+                # window's training and the next window's arrivals;
+                # the join-time snapshot pins the model, so the
+                # result is exactly the sequential loop's
+                self._pending["eval"] = self._submit_eval(
+                    ev, h, ev_derive_s, wi)
+        if self._pending is not None:
+            self._pending["boundary_end"] = time.monotonic()
+        self._results.append(rec)
 
     # -- OPT labeling (test.cpp:97-121) --------------------------------------
 
     def _calculate_opt(self) -> None:
+        """Vectorized admission scan: stable argsort by next-use
+        volume + exclusive cumsum over the would-be-admitted volumes.
+        The scalar loop breaks at the first position whose running
+        volume exceeds the budget and only admitted items grow it, so
+        (the cumsum being monotone) admission is exactly ``has_next &
+        (exclusive_cumsum <= budget)`` — bit-identical to
+        ``_calculate_opt_scalar`` (the early cutoff is the mask; no
+        per-item Python loop)."""
+        w = self.window
+        n = len(w.ids)
+        volume = np.asarray(w.volume, np.int64)
+        has_next = np.asarray(w.has_next, bool)
+        sizes = np.asarray(w.sizes, np.int64)
+        order = np.argsort(volume, kind="stable")
+        cache_volume = self.cache_size * self.window_size
+        hn_o = has_next[order]
+        vol_o = np.where(hn_o, volume[order], 0)
+        cum_before = np.concatenate(
+            [np.zeros(1, np.int64), np.cumsum(vol_o)[:-1]])
+        admit = hn_o & (cum_before <= cache_volume)
+        to_cache = np.zeros(n, bool)
+        to_cache[order[admit]] = True
+        self._opt_hits = int(admit.sum())
+        self._opt_byte_hits = int(sizes[order][admit].sum())
+        w.to_cache = to_cache
+        w._feat_ctx = None          # labels changed: derive ctx stale
+
+    def _calculate_opt_scalar(self) -> None:
+        """Reference transliteration (test.cpp:97-121) — kept as the
+        bit-parity oracle for ``_calculate_opt``."""
         w = self.window
         n = len(w.ids)
         volume = np.asarray(w.volume, np.int64)
@@ -234,6 +426,7 @@ class LrbDriver:
                 self._opt_byte_hits += int(sizes[i])
                 cur += int(volume[i])
         w.to_cache = to_cache
+        w._feat_ctx = None          # labels changed: derive ctx stale
 
     def _opt_ratios(self) -> dict:
         w = self.window
@@ -247,6 +440,113 @@ class LrbDriver:
     # -- feature derivation (test.cpp:124-208) -------------------------------
 
     def _derive_features(self, sampling: int):
+        """Vectorized feature derivation — bit-identical to
+        ``_derive_features_scalar`` (the reference transliteration
+        below, kept as the test oracle).
+
+        The scalar loop's per-request deque walk is a group-by-object
+        gap computation: a stable argsort by object id keeps arrival
+        order within each group, so consecutive sorted slots of one
+        object give the inter-arrival gaps, and request i's feature j
+        is simply the group's (k-j)-th gap (k = i's occurrence index,
+        capped at HISTFEATURES most-recent). The cache-occupancy
+        column follows from the observation that an object is in
+        cache after request r iff to_cache[r]: inserts are 0->1 label
+        transitions (debit the size at the transition), evictions are
+        1->0 transitions (credit the size recorded at the RUN'S first
+        1 — the insertion), and available-bytes is the exclusive
+        cumsum of those deltas in arrival order."""
+        w = self.window
+        n = len(w.ids)
+        if n == 0:
+            return (np.zeros(0, np.float32),
+                    np.zeros((0, NUM_FEATURES), np.float64))
+        # sampling flags: ONE rng draw per request in arrival order,
+        # exactly the scalar loop's stream (Generator.random(n) is the
+        # same double sequence as n scalar draws)
+        if sampling == 1:
+            flag = np.arange(n) >= (self.window_size - self.sample_size)
+        elif sampling == 2:
+            flag = self.rng.random(n) < (self.sample_size
+                                         / self.window_size)
+        else:
+            flag = np.ones(n, bool)
+        ids, sizes, costs, to_cache, gaps, inv, occ, avail = \
+            self._derive_ctx()
+        rows_idx = np.flatnonzero(flag)
+        s = inv[rows_idx]
+        k = np.minimum(occ[s], HISTFEATURES)
+        J = np.arange(HISTFEATURES)
+        valid = J[None, :] < k[:, None]
+        src = np.clip(s[:, None] - J[None, :], 0, n - 1)
+        feat = np.zeros((len(rows_idx), NUM_FEATURES), np.float64)
+        feat[:, :HISTFEATURES] = np.where(valid, gaps[src], 0)
+        feat[:, HISTFEATURES] = np.round(
+            100.0 * np.log2(np.maximum(sizes[rows_idx], 1)))
+        av = avail[rows_idx]
+        feat[:, HISTFEATURES + 1] = np.where(
+            av <= 0, 0.0,
+            np.round(100.0 * np.log2(np.maximum(av, 1))))
+        feat[:, HISTFEATURES + 2] = costs[rows_idx]
+        return to_cache[rows_idx].astype(np.float32), feat
+
+    def _derive_ctx(self):
+        """The sampling-independent half of feature derivation —
+        per-window group/gap/occupancy arrays, computed ONCE per
+        window (the boundary derives twice: the training sample and
+        the eval batch differ only in the final flag slice).
+        Invalidated by ``_calculate_opt`` (labels feed the occupancy
+        deltas) and implicitly by the per-boundary Window reset."""
+        w = self.window
+        ctx = getattr(w, "_feat_ctx", None)
+        if ctx is not None:
+            return ctx
+        n = len(w.ids)
+        ids = np.asarray(w.ids, np.int64)
+        sizes = np.asarray(w.sizes, np.int64)
+        costs = np.asarray(w.costs, np.float64)
+        to_cache = np.asarray(w.to_cache, bool)
+
+        order = np.argsort(ids, kind="stable")
+        sid = ids[order]
+        new_grp = np.concatenate([[True], sid[1:] != sid[:-1]])
+        slot = np.arange(n)
+        starts = np.flatnonzero(new_grp)
+        grp_start = starts[np.cumsum(new_grp) - 1]
+        occ = slot - grp_start              # occurrence index k
+        # gap at sorted slot s (k >= 1): arrival-index difference of
+        # consecutive occurrences of the same object
+        gaps = np.zeros(n, np.int64)
+        cont = ~new_grp
+        gaps[cont] = order[cont] - order[np.flatnonzero(cont) - 1]
+        inv = np.empty(n, np.int64)
+        inv[order] = slot                   # arrival row -> sorted slot
+
+        # cache-occupancy deltas (see _derive_features docstring); the
+        # run-start insert a 1->0 eviction credits is found with a
+        # global maximum.accumulate over insert slots — safe across
+        # group boundaries because an eviction's own group always
+        # contains a nearer insert (prev label 1 needs one)
+        lo = to_cache[order]
+        prev_l = np.concatenate([[False], lo[:-1]]) & cont
+        insert = lo & ~prev_l
+        evict = (~lo) & prev_l
+        so = sizes[order]
+        last_ins = np.maximum.accumulate(np.where(insert, slot, -1))
+        delta_o = np.zeros(n, np.int64)
+        delta_o[insert] = -so[insert]
+        delta_o[evict] = so[last_ins[evict]]
+        delta = np.zeros(n, np.int64)
+        delta[order] = delta_o
+        avail = self.cache_size + np.concatenate(
+            [np.zeros(1, np.int64), np.cumsum(delta)[:-1]])
+        w._feat_ctx = ctx = (ids, sizes, costs, to_cache, gaps, inv,
+                             occ, avail)
+        return ctx
+
+    def _derive_features_scalar(self, sampling: int):
+        """Reference transliteration (test.cpp:124-208) — kept as the
+        bit-parity oracle for the vectorized ``_derive_features``."""
         w = self.window
         n = len(w.ids)
         cache_avail = self.cache_size
@@ -294,14 +594,17 @@ class LrbDriver:
 
     # -- train / evaluate (test.cpp:210-298) ---------------------------------
 
-    def _train_window(self, labels: np.ndarray, X: np.ndarray) -> dict:
-        """Degrade-don't-die wrapper around one window's training: a
-        transient failure retries with bounded backoff (utils/retry.py);
-        a persistent failure — exception, injected fault, or the
-        per-window wall budget — marks the window ``degraded`` and the
-        loop keeps serving the previous model instead of dying. The
-        staleness gauge and the windows_failed/degraded counters flow
-        to the live Prometheus export (obs/export.py)."""
+    def _attempt_window_train(self, labels: np.ndarray, X: np.ndarray,
+                              widx: int):
+        """Degrade-don't-die attempt at one window's training: a
+        transient failure retries with bounded backoff
+        (utils/retry.py); a persistent failure — exception, injected
+        fault, or the per-window wall budget — is captured as the
+        failure reason instead of propagating. Runs on the trainer
+        thread in pipelined mode, inline otherwise.
+
+        -> (stats dict or None, fresh booster handle or None, reason).
+        """
         out = None
         reason = None
         # ONE deadline for the whole window, shared across transient
@@ -312,25 +615,35 @@ class LrbDriver:
         try:
             def attempt():
                 faults.check("lrb.window_train",
-                             context=f"window {self.window_index}")
-                return self._train_model(labels, X, deadline)
+                             context=f"window {widx}")
+                return self._train_model(labels, X, widx, deadline)
             out = retry.call(
-                attempt, what=f"lrb window {self.window_index} train",
+                attempt, what=f"lrb window {widx} train",
                 policy=self._retry_policy)
         except Exception as e:      # noqa: BLE001 — degrade, don't die
             obs.counter("lrb/windows_failed").add(1)
             reason = f"{type(e).__name__}: {e}"
             log.warning(
                 "window %d: training failed (%s); serving continues on "
-                "the model from window %d", self.window_index, reason,
+                "the model from window %d", widx, reason,
                 self._trained_window)
-        rec: dict = {}
-        if out is not None:
+        if out is None:
+            return None, None, reason
+        stats, handle = out
+        return stats, handle, None
+
+    def _apply_train_outcome(self, rec: dict, stats: Optional[dict],
+                             reason: Optional[str]) -> None:
+        """Window-ordered accounting of a training outcome (staleness
+        gauge, degrade counters, result fields) — always on the main
+        thread, at the point the outcome becomes part of the window's
+        record."""
+        if stats is not None:
             self._windows_since_train = 0
-            self._trained_window = self.window_index
-            rec.update(out)
+            self._trained_window = rec["window"]
+            rec.update(stats)
         else:
-            if self.booster is not None or self._trained_window:
+            if self._serving is not None or self._trained_window:
                 self._windows_since_train += 1
             obs.counter("lrb/windows_degraded").add(1)
             rec["degraded"] = True
@@ -338,7 +651,154 @@ class LrbDriver:
         obs.gauge("lrb/model_staleness_windows").set(
             self._windows_since_train)
         rec["staleness_windows"] = self._windows_since_train
-        return rec
+
+    # -- the trainer-thread pipeline -----------------------------------------
+
+    def _submit_train(self, labels: np.ndarray, X: np.ndarray,
+                      rec: dict, t_window: float) -> None:
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="lrb-trainer")
+        self._train_started.clear()
+        fut = self._executor.submit(self._train_async, labels, X,
+                                    self.window_index)
+        self._pending = {"window": self.window_index, "future": fut,
+                         "rec": rec, "t_window": t_window,
+                         "submit_t": time.monotonic()}
+
+    def _submit_eval(self, ev, handle, ev_derive_s: float, wi: dict):
+        """Queue one window's evaluation on the server thread (single
+        worker: windows evaluate in order, so the cumulative serve
+        histogram reads exactly like the sequential loop's).
+
+        -> future of (eval fields dict, completion monotonic)."""
+        if self._eval_executor is None:
+            self._eval_executor = \
+                concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="lrb-server")
+        labels, X = ev
+
+        def eval_job():
+            t0 = time.monotonic()
+            with trace.span("lrb/evaluate", cat="window", args=wi):
+                out = self._score_window(labels, X, handle=handle)
+            out["evaluate_s"] = round(
+                time.monotonic() - t0 + ev_derive_s, 3)
+            return out, time.monotonic()
+
+        return self._eval_executor.submit(eval_job)
+
+    def _train_async(self, labels: np.ndarray, X: np.ndarray,
+                     widx: int):
+        """Trainer-thread body: attempt the window, publish the fresh
+        model on success (pre-warmed — see ``_publish``), and NEVER
+        raise: every failure is folded into the returned reason so the
+        join can only ever degrade the window, not kill the loop.
+
+        -> (stats or None, reason or None, completion monotonic)."""
+        try:
+            if self._train_gate is not None:        # test seam
+                self._train_started.set()
+                self._train_gate.wait(timeout=60.0)
+            with trace.span("lrb/train", cat="window",
+                            args={"window": widx}):
+                stats, handle, reason = self._attempt_window_train(
+                    labels, X, widx)
+                if handle is not None:
+                    self._publish(handle, widx)
+            return stats, reason, time.monotonic()
+        except BaseException as e:  # noqa: BLE001 — the loop must live
+            obs.counter("lrb/windows_failed").add(1)
+            return None, f"{type(e).__name__}: {e}", time.monotonic()
+
+    def _publish(self, handle, widx: int) -> None:
+        """Publish-on-complete atomic model swap. The stacked serving
+        path is built (and its serve-bucket program warmed) BEFORE the
+        swap — on the trainer thread, under the booster's own serving
+        lock — so a live request stream never pays the new model's
+        cold tail; in-flight ``predict_live`` readers keep the old
+        handle they snapshotted. A degraded window never reaches here:
+        the swap simply does not happen."""
+        try:
+            handle.gbdt.prepare_serving(warm_rows=self.serve_batch)
+        except Exception as e:  # noqa: BLE001 — never drop a good model
+            log.warning("window %d: serving warm-up failed (%s); "
+                        "publishing cold", widx, e)
+        with self._swap_lock:
+            self._serving = handle
+        obs.counter("lrb/model_swaps").add(1)
+        trace.instant("lrb/swap", cat="window", args={"window": widx})
+
+    def _join_pending(self) -> None:
+        with self._join_lock:
+            self._join_pending_locked()
+
+    def _join_pending_locked(self) -> None:
+        p = self._pending
+        if p is None:
+            return
+        t_join = time.monotonic()
+        with trace.span("lrb/join", cat="window",
+                        args={"window": p["window"]}):
+            # _pending stays visible while we block here:
+            # training_in_flight() must keep answering True to the
+            # scorer for a trainer that overran the boundary — those
+            # are exactly the during-retrain probes
+            stats, reason, t_train = p["future"].result()
+            t_done = t_train
+            ev_fut = p.get("eval")
+            if ev_fut is not None:
+                ev_fields, t_eval = ev_fut.result()
+                p["rec"].update(ev_fields)
+                t_done = max(t_done, t_eval)
+        self._pending = None
+        rec = p["rec"]
+        self._apply_train_outcome(rec, stats, reason)
+        # overlap: how long the TRAINING ran while the main thread was
+        # doing other work (ingesting/deriving the next window) — the
+        # wall the pipeline reclaims vs the sequential loop; the eval
+        # thread's tail is deliberately NOT counted here
+        overlap = max(0.0, min(t_train, t_join) - p["submit_t"])
+        rec["overlap_s"] = round(overlap, 3)
+        obs.gauge("lrb/pipeline_overlap_s").set(round(overlap, 6))
+        # window span: boundary open -> the LATEST of training
+        # completion, evaluation completion and the boundary itself
+        self._finish_window(
+            rec, max(t_done, p.get("boundary_end", t_done))
+            - p["t_window"])
+
+    def drain(self) -> None:
+        """Join any in-flight window training so ``results`` /
+        ``booster`` reflect every completed window. No-op in
+        sequential mode or between windows."""
+        if self._pending is not None:
+            self._join_pending()
+
+    def close(self) -> None:
+        """Drain and shut the trainer/server threads down (a later
+        window would lazily restart them)."""
+        self.drain()
+        for attr in ("_executor", "_eval_executor"):
+            ex = getattr(self, attr)
+            if ex is not None:
+                ex.shutdown(wait=True)
+                setattr(self, attr, None)
+
+    def _finish_window(self, rec: dict, wall: float) -> None:
+        """A window's record is complete (sequential: at the boundary;
+        pipelined: when its training resolves): quantile-grade wall
+        bookkeeping, the result line, and a trace/result flush so a
+        live loop can be inspected mid-run and a killed run keeps its
+        last finished window."""
+        rec["window_wall_s"] = round(wall, 3)
+        self._wall_hist.observe(wall)
+        obs.latency_histogram("lrb/window_wall_s").observe(wall)
+        print(f"window {rec['window']}: "
+              + " ".join(f"{k}={v}" for k, v in rec.items()),
+              file=self.out)
+        if hasattr(self.out, "flush"):
+            self.out.flush()
+        trace.write()
 
     def degraded_windows(self) -> int:
         """Windows that did not produce a fresh model (failed training,
@@ -346,15 +806,17 @@ class LrbDriver:
         return sum(1 for r in self.results if r.get("degraded"))
 
     def _train_model(self, labels: np.ndarray, X: np.ndarray,
-                     deadline: Optional[float] = None) -> Optional[dict]:
+                     widx: int,
+                     deadline: Optional[float] = None):
         if len(labels) == 0 or len(np.unique(labels)) < 2:
             log.warning("window %d: degenerate labels; keeping previous "
-                        "model", self.window_index)
+                        "model", widx)
             return None
         from .ops import step_cache
         s0 = step_cache.stats()
         t0 = time.monotonic()
-        ds = capi.LGBM_DatasetCreateFromMat(X, parameters=self.params)
+        ds = capi.LGBM_DatasetCreateFromMat(X, parameters=self.params,
+                                            ring=self._ring)
         capi.LGBM_DatasetSetField(ds, "label", labels)
         # always a FRESH booster per window (test.cpp:281-295) — but
         # NOT a fresh compile: the windows' row counts, observed bin
@@ -368,10 +830,10 @@ class LrbDriver:
         for _ in range(int(self.params["num_iterations"])):
             if deadline is not None and time.monotonic() > deadline:
                 # blown wall budget: the partial booster is DISCARDED
-                # (self.booster unchanged) — a half-trained model must
-                # never serve
+                # (the serving model is unchanged) — a half-trained
+                # model must never serve
                 raise WindowBudgetExceeded(
-                    f"window {self.window_index}: training exceeded "
+                    f"window {widx}: training exceeded "
                     f"the {self.window_budget_s:g}s wall budget; "
                     f"keeping the previous model")
             if capi.LGBM_BoosterUpdateOneIter(booster):
@@ -384,18 +846,19 @@ class LrbDriver:
         compile_s = s1["compile_s"] - s0["compile_s"]
         log.info("window %d: %d rows trained in %.2fs (step compile "
                  "%.2fs, step cache +%d hit / +%d miss)",
-                 self.window_index, len(labels), train_s, compile_s,
+                 widx, len(labels), train_s, compile_s,
                  s1["hits"] - s0["hits"], s1["misses"] - s0["misses"])
-        self.booster = booster
-        return {"train_s": round(train_s, 3),
-                "compile_s": round(compile_s, 3),
-                "step_cache_hits": s1["hits"] - s0["hits"]}
+        return ({"train_s": round(train_s, 3),
+                 "compile_s": round(compile_s, 3),
+                 "step_cache_hits": s1["hits"] - s0["hits"]},
+                booster)
 
     def window_wall_quantiles(self) -> Optional[dict]:
         """p50/p95/p99 window wall from THIS driver's log-bucketed
         latency instrument (obs/registry.py latency_histogram) —
         quantiles, not just means; None before the first window
-        completes."""
+        completes. Pipelined windows count boundary-to-publish."""
+        self.drain()
         if not self._wall_hist.count:
             return None
         return {k: round(v, 3)
@@ -403,34 +866,48 @@ class LrbDriver:
                 if v is not None}
 
     def serve_latency_quantiles(self) -> Optional[dict]:
-        """p50/p95/p99 per-request serving latency from the driver's
+        """p50/p95/p99 PER-REQUEST serving latency from the driver's
         own instrument; None before the first evaluated window."""
+        self.drain()
         if not self._serve_hist.count:
             return None
         return {k: round(v, 6)
                 for k, v in self._serve_hist.quantiles().items()
                 if v is not None}
 
-    def _evaluate_model(self) -> dict:
-        labels, X = self._derive_features(0)
+    def _score_window(self, labels: np.ndarray, X: np.ndarray,
+                      handle=None) -> dict:
         # the serving half of the loop: this window's requests scored
         # against the previous window's model in micro-batches through
         # the geometry-keyed predict path (pow2 serve buckets,
         # ops/predict_cache.py) — every batch after the first rides a
-        # warm compiled program, and each call's wall is one request
-        # latency in the driver-owned histogram
+        # warm compiled program. Each micro-batch's wall is ONE
+        # serve_batch_s observation and `rows` serve_latency_s
+        # observations (each request in it waited the batch out), so
+        # the p99 an operator reads is a REQUEST quantile. ``handle``
+        # pins the model (the pipelined boundary's join-time snapshot);
+        # None = the currently published one.
+        if handle is not None:
+            h = handle
+        else:
+            with self._swap_lock:
+                h = self._serving
         n = len(labels)
         b = self.serve_batch
         parts = []
         global_hist = obs.latency_histogram("lrb/serve_latency_s")
+        global_batch = obs.latency_histogram("lrb/serve_batch_s")
         for r0 in range(0, n, b):
+            rows = min(b, n - r0)
             t0 = time.monotonic()
             parts.append(np.asarray(capi.LGBM_BoosterPredictForMat(
-                self.booster, X[r0:r0 + b],
+                h, X[r0:r0 + b],
                 predict_type=capi.C_API_PREDICT_NORMAL)))
             dt = time.monotonic() - t0
-            self._serve_hist.observe(dt)
-            global_hist.observe(dt)
+            self._serve_batch_hist.observe(dt)
+            global_batch.observe(dt)
+            self._serve_hist.observe_n(dt, rows)
+            global_hist.observe_n(dt, rows)
         preds = (np.concatenate(parts) if parts
                  else np.zeros(0, np.float64))
         fp = ((labels < self.cutoff) & (preds >= self.cutoff)).sum()
@@ -490,6 +967,7 @@ def run_trace_file(path: str, cache_size: int, window_size: int,
                 continue
             seq += 1
             driver.process_request(seq, *req)
+    driver.drain()
     driver.trace_lines_skipped = skipped
     if skipped:
         log.warning("%s: skipped %d malformed trace line(s) in total "
@@ -509,18 +987,13 @@ def synthetic_trace(n_requests: int, n_objects: int = 200,
         yield i + 1, int(oid), int(sizes[oid]), 1.0
 
 
-def main(argv=None):
-    argv = sys.argv[1:] if argv is None else argv
-    if len(argv) < 6:
-        print("parameters: tracePath cacheSize windowSize sampleSize "
-              "cutoff sampling [resultFile]", file=sys.stderr)
-        sys.exit(1)
+def _run_main(argv, out) -> None:
     trace_path, cache_size, window_size, sample_size, cutoff, sampling = \
         argv[0], int(argv[1]), int(argv[2]), int(argv[3]), \
         float(argv[4]), int(argv[5])
-    out = open(argv[6], "w") if len(argv) > 6 else sys.stdout
     driver = run_trace_file(trace_path, cache_size, window_size,
                             sample_size, cutoff, sampling, out)
+    driver.close()
     q = driver.window_wall_quantiles()
     if q:
         print("window_wall " + " ".join(f"{k}={v}s"
@@ -536,6 +1009,22 @@ def main(argv=None):
         print(f"degraded_windows={dw} "
               f"model_staleness_windows={driver._windows_since_train}",
               file=out)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 6:
+        print("parameters: tracePath cacheSize windowSize sampleSize "
+              "cutoff sampling [resultFile]", file=sys.stderr)
+        sys.exit(1)
+    if len(argv) > 6:
+        # context-managed: a crash mid-run must not strand buffered
+        # tail windows in a never-closed handle (the driver also
+        # flushes after every finished window)
+        with open(argv[6], "w") as out:
+            _run_main(argv, out)
+    else:
+        _run_main(argv, sys.stdout)
 
 
 if __name__ == "__main__":

@@ -1554,6 +1554,22 @@ class GBDT:
             self._stacked_cache = (key, sm)
             return sm
 
+    def prepare_serving(self, warm_rows: int = 0) -> bool:
+        """Pre-build this model's serving path BEFORE it is published
+        into a live request stream — the swap seam of the pipelined
+        lrb loop: the trainer thread calls this on the freshly trained
+        booster, so the atomic model swap hands over a predictor whose
+        stacked tables (and, with ``warm_rows`` > 0, the compiled
+        program for that serve-bucket shape) are already warm. Runs
+        under the serving lock like every stacked build; returns True
+        when a stacked predictor is available."""
+        sm = self._stacked_model() if len(self.models) >= 1 else None
+        if sm is None:
+            return False
+        if warm_rows > 0:
+            sm.warmup(warm_rows)
+        return True
+
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:414-430). Training may resume
         afterwards, so the stop latch is cleared."""

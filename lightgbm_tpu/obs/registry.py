@@ -121,7 +121,18 @@ class Histogram:
         self._max: Optional[float] = None
 
     def observe(self, v: float) -> None:
+        self.observe_n(v, 1)
+
+    def observe_n(self, v: float, n: int) -> None:
+        """Record ``n`` observations of the same value in one bucket
+        walk — the per-request normalization of a batched call: every
+        request in a ``n``-row micro-batch experienced the batch's
+        wall, so the batch contributes ``n`` request latencies, not
+        one (lrb.py serve path). Quantiles then rank REQUESTS."""
         v = float(v)
+        n = int(n)
+        if n <= 0:
+            return
         with self._lock:
             i = 0
             for i, b in enumerate(self.buckets):       # noqa: B007
@@ -129,9 +140,9 @@ class Histogram:
                     break
             else:
                 i = len(self.buckets)
-            self._counts[i] += 1
-            self._count += 1
-            self._sum += v
+            self._counts[i] += n
+            self._count += n
+            self._sum += v * n
             self._min = v if self._min is None else min(self._min, v)
             self._max = v if self._max is None else max(self._max, v)
 

@@ -543,6 +543,21 @@ class StackedModel:
                              for c0 in range(0, N, chunk))
         return [(runner(part), nrows) for part, nrows in parts]
 
+    def warmup(self, rows: int = 1) -> bool:
+        """Run one throwaway predict over ``rows`` zero rows so the
+        device stacks upload and the serve-bucket program for this
+        batch shape compiles NOW, not on the first live request — the
+        publish seam of a retrain-while-serve swap (lrb.py) calls this
+        on the trainer thread before the new model goes live, so the
+        post-swap request stream never pays the cold tail. A
+        same-geometry predecessor makes this a registry hit
+        (ops/predict_cache.py) and the cost is one warm dispatch."""
+        if not self.ok:
+            return False
+        self.predict(np.zeros((max(int(rows), 1), self._F),
+                              np.float64))
+        return True
+
     def predict(self, X: np.ndarray, first: int = 0,
                 ntree: Optional[int] = None,
                 pred_leaf: bool = False,

@@ -178,6 +178,121 @@ def test_cli_pass_fail_and_exit_codes(tmp_path):
     assert cbr.main([str(bad), "--schema-only"]) == 0
 
 
+# -- lrb-stream (retrain-while-serve) gate -----------------------------------
+
+def _stream(requests_per_s=230.0, staleness=0.0, p99d=45.0, **kw):
+    d = {"windows": 8, "window_rows": 2048,
+         "requests_per_s": requests_per_s,
+         "staleness_p99_windows": staleness,
+         "serve_p99_during_retrain_ms": p99d,
+         "speedup": 2.5}
+    d.update(kw)
+    return d
+
+
+def test_check_schema_lrb_stream():
+    # the standalone --lrb-stream line: unit requests/s + stream block
+    standalone = {"metric": "LRB streaming retrain-while-serve (8...)",
+                  "value": 230.0, "unit": "requests/s",
+                  "lrb_stream": _stream()}
+    assert cbr.check_schema(standalone) == []
+    # a training line CARRYING the appended stream section
+    assert cbr.check_schema(_fresh(lrb_stream=_stream())) == []
+    # requests/s without the block is a shape problem
+    assert any("lrb_stream" in p for p in cbr.check_schema(
+        {"metric": "m", "value": 1.0, "unit": "requests/s"}))
+    # missing gate fields are named
+    broken = _stream()
+    del broken["requests_per_s"]
+    assert any("requests_per_s" in p
+               for p in cbr.check_schema(_fresh(lrb_stream=broken)))
+    # during-retrain p99 may be null (fast trainer), not a wrong type
+    assert cbr.check_schema(_fresh(lrb_stream=_stream(p99d=None))) == []
+    assert any("serve_p99_during_retrain_ms" in p for p in
+               cbr.check_schema(_fresh(lrb_stream=_stream(p99d="n/a"))))
+    assert any("not a dict" in p
+               for p in cbr.check_schema(_fresh(lrb_stream="n/a")))
+
+
+def test_compare_lrb_stream_gate():
+    base = _fresh(lrb_stream=_stream(requests_per_s=200.0,
+                                     staleness=0.0))
+    # within tolerance: pass
+    assert cbr.compare(_fresh(lrb_stream=_stream(
+        requests_per_s=190.0, staleness=0.5)), base) == []
+    # sustained requests/s floor (same 20% tolerance as throughput)
+    probs = cbr.compare(_fresh(lrb_stream=_stream(
+        requests_per_s=100.0)), base)
+    assert probs and "serving-throughput regression" in probs[0]
+    # staleness lag ceiling: absolute slack in windows
+    probs = cbr.compare(_fresh(lrb_stream=_stream(staleness=2.0)),
+                        base)
+    assert probs and "staleness regression" in probs[0]
+    assert cbr.compare(_fresh(lrb_stream=_stream(staleness=2.0)),
+                       base, staleness_slack=3.0) == []
+    # old baselines without the section gate nothing
+    assert cbr.compare(_fresh(lrb_stream=_stream(
+        requests_per_s=1.0, staleness=99.0)), _fresh()) == []
+    # a fresh run that LOST the section cannot silently pass
+    probs = cbr.compare(_fresh(), base)
+    assert any("no lrb_stream.requests_per_s" in p for p in probs)
+    # cross-workload refusal still wins
+    probs = cbr.compare(_fresh(metric="other",
+                               lrb_stream=_stream()), base)
+    assert len(probs) == 1 and "not comparable" in probs[0]
+    # a baseline with a DIFFERENT stream shape gates nothing: the
+    # training metric string does not embed the stream geometry, so
+    # requests/s from a 4x-larger window is not a comparable floor
+    assert cbr.compare(
+        _fresh(lrb_stream=_stream(requests_per_s=10.0,
+                                  window_rows=512)), base) == []
+
+
+def test_cli_lrb_stream_walks_back_to_latest_carrier(tmp_path):
+    """When the newest trajectory point predates the stream bench,
+    the lrb-stream fields gate against the LATEST same-workload point
+    that carries them — old points gate nothing beyond that."""
+    base_dir = tmp_path / "repo"
+    base_dir.mkdir()
+    (base_dir / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": _fresh(value=49.0,
+                          lrb_stream=_stream(requests_per_s=200.0))}))
+    (base_dir / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": _fresh(value=49.0)}))      # newest: no stream block
+    slow_serve = tmp_path / "fresh.json"
+    slow_serve.write_text(json.dumps(_fresh(
+        value=49.0, lrb_stream=_stream(requests_per_s=50.0))))
+    assert cbr.main([str(slow_serve), "--baseline-dir",
+                     str(base_dir)]) == 1
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_fresh(
+        value=49.0, lrb_stream=_stream(requests_per_s=195.0))))
+    assert cbr.main([str(ok), "--baseline-dir", str(base_dir)]) == 0
+    # --staleness-slack reaches the comparison
+    lagged = tmp_path / "lagged.json"
+    lagged.write_text(json.dumps(_fresh(
+        value=49.0, lrb_stream=_stream(requests_per_s=200.0,
+                                       staleness=0.8))))
+    assert cbr.main([str(lagged), "--baseline-dir",
+                     str(base_dir)]) == 0
+    assert cbr.main([str(lagged), "--baseline-dir", str(base_dir),
+                     "--staleness-slack", "0.25"]) == 1
+    # a newest point carrying a DIFFERENT stream shape must not
+    # disable the gate either: walk back to the same-shape carrier
+    (base_dir / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": _fresh(value=49.0,
+                          lrb_stream=_stream(requests_per_s=5000.0,
+                                             window_rows=256))}))
+    assert cbr.main([str(slow_serve), "--baseline-dir",
+                     str(base_dir)]) == 1
+    assert cbr.main([str(ok), "--baseline-dir", str(base_dir)]) == 0
+    # a fresh run that LOST the section cannot hide behind a newest
+    # point that also lacks it: the walk-back still finds the carrier
+    lost = tmp_path / "lost.json"
+    lost.write_text(json.dumps(_fresh(value=49.0)))
+    assert cbr.main([str(lost), "--baseline-dir", str(base_dir)]) == 1
+
+
 # -- end-to-end (slow): a real quick bench through the gate ------------------
 
 @pytest.mark.slow

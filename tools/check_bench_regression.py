@@ -43,6 +43,9 @@ from typing import List, Optional
 DEFAULT_THROUGHPUT_TOL = 0.20
 DEFAULT_AUC_TOL = 2e-3
 DEFAULT_LATENCY_TOL = 0.50
+# model-staleness lag is integral windows: an absolute slack reads
+# better than a percentage of a number that is usually 0
+DEFAULT_STALENESS_SLACK = 1.0
 
 # the wrapper's stderr tail carries the AUC line for trajectory points
 # that predate the in-JSON train_auc/test_auc fields
@@ -81,14 +84,42 @@ def trajectory(baseline_dir: str) -> List[str]:
 
 
 def check_schema(fresh: dict) -> List[str]:
-    """Shape problems in a (normalized) fresh bench artifact."""
+    """Shape problems in a (normalized) fresh bench artifact — the
+    HIGGS-class training line (unit ``M row-iters/s``) or the
+    standalone ``bench.py --lrb-stream`` line (unit ``requests/s``,
+    details under ``lrb_stream``); a training line may also CARRY an
+    ``lrb_stream`` section (the appended compact stream bench)."""
     problems = []
+    stream_only = fresh.get("unit") == "requests/s"
     if not isinstance(fresh.get("value"), (int, float)):
-        problems.append("missing numeric 'value' (M row-iters/s)")
-    if fresh.get("unit") != "M row-iters/s":
+        problems.append("missing numeric 'value' "
+                        + ("(requests/s)" if stream_only
+                           else "(M row-iters/s)"))
+    if stream_only:
+        if not isinstance(fresh.get("lrb_stream"), dict):
+            problems.append("unit requests/s but no 'lrb_stream' "
+                            "object")
+    elif fresh.get("unit") != "M row-iters/s":
         problems.append(f"unexpected unit {fresh.get('unit')!r}")
     if not isinstance(fresh.get("metric"), str):
         problems.append("missing 'metric' workload descriptor")
+    ls = fresh.get("lrb_stream")
+    if ls is not None:
+        if not isinstance(ls, dict):
+            problems.append(
+                f"lrb_stream is {type(ls).__name__}, not a dict")
+        else:
+            for k in ("requests_per_s", "staleness_p99_windows"):
+                if not isinstance(ls.get(k), (int, float)):
+                    problems.append(f"lrb_stream.{k} missing/null")
+            # during-retrain quantiles may legitimately be null (a
+            # fast trainer can finish between scorer requests) but
+            # must not be a wrong type
+            p99d = ls.get("serve_p99_during_retrain_ms")
+            if p99d is not None and not isinstance(p99d, (int, float)):
+                problems.append(
+                    "lrb_stream.serve_p99_during_retrain_ms is "
+                    f"{type(p99d).__name__}, not numeric/null")
     lat = fresh.get("predict_latency")
     if lat is not None:
         if not isinstance(lat, dict):
@@ -131,7 +162,9 @@ def field_notes(doc: dict) -> List[str]:
 def compare(fresh: dict, baseline: dict,
             throughput_tol: float = DEFAULT_THROUGHPUT_TOL,
             auc_tol: float = DEFAULT_AUC_TOL,
-            latency_tol: float = DEFAULT_LATENCY_TOL) -> List[str]:
+            latency_tol: float = DEFAULT_LATENCY_TOL,
+            staleness_slack: float = DEFAULT_STALENESS_SLACK
+            ) -> List[str]:
     """Regression problems of ``fresh`` vs one ``baseline`` point
     (both normalized); empty list == pass. Refuses cross-workload
     comparisons (the metric strings embed the shape)."""
@@ -155,6 +188,77 @@ def compare(fresh: dict, baseline: dict,
     elif isinstance(ba, (int, float)):
         problems.append("fresh run carries no test_auc to compare")
     problems += _compare_latency(fresh, baseline, latency_tol)
+    problems += _compare_lrb_stream(fresh, baseline, throughput_tol,
+                                    staleness_slack)
+    return problems
+
+
+def _stream_shape(stream: dict) -> tuple:
+    """The lrb-stream workload shape (the training-line metric string
+    does not embed it, so comparability must be checked here)."""
+    return tuple(stream.get(k) for k in ("windows", "window_rows",
+                                         "sample_rows", "iters"))
+
+
+def _stream_comparable(fresh: dict, baseline: dict) -> bool:
+    """True when the baseline's lrb_stream block can gate this fresh
+    run: it exists, and either predates the shape fields or matches
+    the fresh run's stream shape."""
+    bs = baseline.get("lrb_stream")
+    if not isinstance(bs, dict):
+        return False
+    fs = fresh.get("lrb_stream")
+    if not isinstance(fs, dict):
+        return True         # lost-section check still applies
+    return (not any(v is not None for v in _stream_shape(bs))
+            or _stream_shape(fs) == _stream_shape(bs))
+
+
+def _compare_lrb_stream(fresh: dict, baseline: dict,
+                        throughput_tol: float,
+                        staleness_slack: float) -> List[str]:
+    """Streaming retrain-while-serve gate (``lrb_stream``): sustained
+    requests/s (floor, like throughput) and model-staleness p99 lag
+    (ceiling, absolute window slack). Only fires when the BASELINE
+    carries the fields — trajectory points predating the stream bench
+    gate nothing; a fresh run that LOST them against a baseline that
+    has them is itself a problem. A baseline whose stream SHAPE
+    (windows x rows, sample, iters) differs gates nothing either:
+    requests/s measured on a 4x-larger window is not a comparable
+    floor (the same different-workload rule the metric string enforces
+    for the training line)."""
+    bs = baseline.get("lrb_stream")
+    if not isinstance(bs, dict):
+        return []
+    if not _stream_comparable(fresh, baseline):
+        return []
+    fs_raw = fresh.get("lrb_stream")
+    fs = fs_raw if isinstance(fs_raw, dict) else {}
+    problems = []
+    brps = bs.get("requests_per_s")
+    if isinstance(brps, (int, float)):
+        frps = fs.get("requests_per_s")
+        if not isinstance(frps, (int, float)):
+            problems.append("fresh run carries no "
+                            "lrb_stream.requests_per_s to compare")
+        else:
+            floor = (1.0 - throughput_tol) * brps
+            if frps < floor:
+                problems.append(
+                    f"serving-throughput regression: {frps:g} "
+                    f"requests/s < {floor:g} (baseline {brps:g} - "
+                    f"{throughput_tol:.0%})")
+    bst = bs.get("staleness_p99_windows")
+    if isinstance(bst, (int, float)):
+        fst = fs.get("staleness_p99_windows")
+        if not isinstance(fst, (int, float)):
+            problems.append("fresh run carries no "
+                            "lrb_stream.staleness_p99_windows to "
+                            "compare")
+        elif fst > bst + staleness_slack:
+            problems.append(
+                f"staleness regression: p99 lag {fst:g} windows > "
+                f"baseline {bst:g} + {staleness_slack:g}")
     return problems
 
 
@@ -211,6 +315,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "increase vs the latest baseline carrying the "
                          "quantiles (default 0.50 — per-request walls "
                          "are noisier than throughput)")
+    ap.add_argument("--staleness-slack", type=float,
+                    default=DEFAULT_STALENESS_SLACK,
+                    help="allowed absolute increase of the lrb-stream "
+                         "model-staleness p99 lag in windows vs the "
+                         "latest baseline carrying it (default 1.0)")
     ap.add_argument("--schema-only", action="store_true",
                     help="validate the fresh artifact's shape only "
                          "(quick runs are not comparable to the "
@@ -241,10 +350,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     baseline = load_bench(points[-1])
     problems = compare(fresh, baseline, args.throughput_tol,
-                       args.auc_tol, args.latency_tol)
+                       args.auc_tol, args.latency_tol,
+                       args.staleness_slack)
+    baseline_name = os.path.basename(points[-1])
+    # the lrb-stream fields gate against the LATEST point CARRYING
+    # them comparably: when the newest point predates the stream
+    # bench (or carries a different stream shape), walk back for a
+    # same-workload comparable point — including when the FRESH run
+    # lost the section (the walk-back is exactly what catches that
+    # against an older carrier; cross-workload refusal above still
+    # wins — a refused comparison never reaches here)
+    if not problems and not _stream_comparable(fresh, baseline):
+        for p in reversed(points[:-1]):
+            cand = load_bench(p)
+            if (cand.get("metric") == fresh.get("metric")
+                    and _stream_comparable(fresh, cand)):
+                got = _compare_lrb_stream(fresh, cand,
+                                          args.throughput_tol,
+                                          args.staleness_slack)
+                if got:
+                    problems = got
+                    baseline_name = os.path.basename(p)
+                break
     if problems:
         for p in problems:
-            print(f"REGRESSION vs {os.path.basename(points[-1])}: {p}",
+            print(f"REGRESSION vs {baseline_name}: {p}",
                   file=sys.stderr)
         return 1 if not problems[0].startswith("not comparable") else 2
     print(f"pass: {fresh['value']:g} {fresh['unit']} vs "
