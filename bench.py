@@ -218,6 +218,148 @@ def lrb_stream_bench(args) -> dict:
     return stream
 
 
+def make_ctr_sparse(n_rows: int, n_features: int, density: float,
+                    seed: int = 11):
+    """Synthetic CTR-shaped sparse task: ~density*F active hashed
+    features per row with small integer-ish values (one-hot-with-
+    counts, the ad-click shape), labels from a sparse linear logit.
+    O(nnz) generation — the dense matrix never exists here either."""
+    from lightgbm_tpu.io.sparse import SparseMatrix
+    rng = np.random.default_rng(seed)
+    k = max(1, int(round(n_features * density)))
+    rows = np.repeat(np.arange(n_rows, dtype=np.int64), k)
+    cols = rng.integers(0, n_features, size=n_rows * k)
+    key = rows * n_features + cols
+    _, first = np.unique(key, return_index=True)   # drop dup cells
+    rows, cols = rows[first], cols[first]
+    vals = rng.integers(1, 16, size=len(rows)).astype(np.float64)
+    indptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(rows, minlength=n_rows))])
+    w = rng.normal(size=n_features)
+    logits = np.zeros(n_rows)
+    np.add.at(logits, rows, w[cols] * np.log1p(vals))
+    y = (logits + 0.5 * rng.normal(size=n_rows) > 0).astype(np.float32)
+    sm = SparseMatrix(vals, cols.astype(np.int64),
+                      indptr.astype(np.int64), (n_rows, n_features))
+    return sm, y
+
+
+def sparse_route_run(args) -> dict:
+    """ONE route of the sparse bench, run in its own process so each
+    route's ru_maxrss watermark is its own (--sparse-route {dense,csr}):
+    the SAME synthetic CSR workload trained through the dense-densified
+    path or the CSR-native route, reporting wall, throughput, host peak
+    RSS and a tree-section hash (the parent asserts cross-route
+    parity)."""
+    import hashlib
+    import resource
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    sm, y = make_ctr_sparse(args.sparse_rows, args.sparse_features,
+                            args.sparse_density)
+    t0 = time.time()
+    cfg = Config().set({
+        "objective": "binary", "max_bin": args.max_bin,
+        "num_leaves": min(args.leaves, 63), "min_data_in_leaf": 20,
+        "learning_rate": 0.1, "tpu_stop_check_interval": 10_000,
+        "tpu_quantized_hist": not args.no_quant,
+        "tpu_ingest": 0 if args.no_ingest else -1,
+    })
+    X = sm.to_dense() if args.sparse_route == "dense" else sm
+    ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+    obj = create_objective("binary", cfg)
+    obj.init(ds.metadata, ds.num_data)
+    g = GBDT()
+    g.init(cfg, ds, obj, [])
+    ingest_s = time.time() - t0
+    t0 = time.time()
+    for _ in range(args.sparse_iters):
+        g.train_one_iter()
+    float(np.asarray(g._scores[0, :1])[0])      # drain the queue
+    train_s = time.time() - t0
+    # model parity across routes: the tree sections only (the
+    # parameters: block echoes per-route knobs)
+    trees = g.model_to_string().split("\nparameters:\n")[0]
+    rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return {
+        "route": args.sparse_route,
+        "rows": args.sparse_rows, "features": args.sparse_features,
+        "nnz": sm.nnz, "density": round(sm.density, 5),
+        "iters": args.sparse_iters,
+        "ingest_s": round(ingest_s, 3),
+        "train_s": round(train_s, 3),
+        "rows_per_s": round(
+            args.sparse_rows * args.sparse_iters / max(train_s, 1e-9),
+            1),
+        "peak_rss_mb": round(rss_kb / 1024.0, 1),
+        "sparse_hist_tier": bool(g._grower_cfg.sparse_hist),
+        "model_sha1": hashlib.sha1(trees.encode()).hexdigest(),
+    }
+
+
+def sparse_bench(args) -> dict:
+    """The sparse CTR workload bench (--sparse): the same CSR matrix
+    trained dense-densified vs CSR-native, each route in a fresh
+    subprocess so 'peak host RSS' is per-route truth (ru_maxrss is a
+    process-lifetime high-water mark). Appends both routes + the RSS
+    ratio to the JSON line; refuses silently-diverged models."""
+    import subprocess
+
+    if args.quick:
+        args.sparse_rows = min(args.sparse_rows, 20_000)
+        args.sparse_iters = min(args.sparse_iters, 8)
+    routes = {}
+    for route in ("dense", "csr"):
+        cmd = [sys.executable, __file__, "--sparse-route", route,
+               "--sparse-rows", str(args.sparse_rows),
+               "--sparse-features", str(args.sparse_features),
+               "--sparse-density", str(args.sparse_density),
+               "--sparse-iters", str(args.sparse_iters),
+               "--max-bin", str(args.max_bin),
+               "--leaves", str(args.leaves)]
+        if args.no_quant:
+            cmd.append("--no-quant")
+        if args.no_ingest:
+            cmd.append("--no-ingest")
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            print(proc.stderr[-2000:], file=sys.stderr)
+            raise RuntimeError(f"sparse route {route!r} failed "
+                               f"(exit {proc.returncode})")
+        routes[route] = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(f"# sparse {route}: {routes[route]['rows_per_s']:.0f} "
+              f"rows/s, peak RSS {routes[route]['peak_rss_mb']:.0f} MB "
+              f"(ingest {routes[route]['ingest_s']:.2f}s, train "
+              f"{routes[route]['train_s']:.2f}s)", file=sys.stderr)
+    parity = (routes["dense"]["model_sha1"]
+              == routes["csr"]["model_sha1"])
+    if not parity:
+        print("# WARNING: sparse routes trained DIFFERENT models",
+              file=sys.stderr)
+    out = {
+        "rows": args.sparse_rows, "features": args.sparse_features,
+        "density": routes["csr"]["density"],
+        "nnz": routes["csr"]["nnz"], "iters": args.sparse_iters,
+        "routes": {k: {kk: vv for kk, vv in v.items()
+                       if kk not in ("rows", "features", "nnz",
+                                     "density", "iters")}
+                   for k, v in routes.items()},
+        "peak_rss_ratio": round(
+            routes["dense"]["peak_rss_mb"]
+            / max(routes["csr"]["peak_rss_mb"], 1e-9), 3),
+        "model_parity": parity,
+    }
+    print(f"# sparse bench: dense {routes['dense']['peak_rss_mb']:.0f}"
+          f" MB vs csr {routes['csr']['peak_rss_mb']:.0f} MB peak RSS "
+          f"({out['peak_rss_ratio']:.2f}x), model parity {parity}",
+          file=sys.stderr)
+    return out
+
+
 def _auc(y, s):
     """Holdout AUC through the engine's own metric implementation."""
     from lightgbm_tpu.config import Config
@@ -288,9 +430,45 @@ def main():
                          "lrb-stream feeder; -1 = auto-calibrate so "
                          "one window of arrivals spans ~2.5x the warm "
                          "training wall; 0 = closed loop (no pacing)")
+    ap.add_argument("--sparse", action="store_true",
+                    help="run ONLY the sparse CTR workload bench: the "
+                         "same synthetic CSR matrix trained "
+                         "dense-densified vs CSR-native (io/sparse.py)"
+                         ", each route in its own subprocess so host "
+                         "peak RSS is per-route; emits a standalone "
+                         "JSON line (unit rows/s, details under "
+                         "'sparse')")
+    ap.add_argument("--sparse-route", default="",
+                    choices=["", "dense", "csr"],
+                    help="(internal) run ONE sparse-bench route in "
+                         "this process and print its JSON")
+    ap.add_argument("--sparse-rows", type=int, default=200_000)
+    ap.add_argument("--sparse-features", type=int, default=256)
+    ap.add_argument("--sparse-density", type=float, default=0.01,
+                    help="fraction of explicit cells in the synthetic "
+                         "CTR workload (default ~1%%)")
+    ap.add_argument("--sparse-iters", type=int, default=30)
     args = ap.parse_args()
     if args.quick:
         args.rows, args.iters, args.leaves = 65_536, 20, 63
+
+    if args.sparse_route:
+        print(json.dumps(sparse_route_run(args)))
+        return
+
+    if args.sparse:
+        sparse = sparse_bench(args)
+        print(json.dumps({
+            "sparse": sparse,
+            "metric": (f"sparse CTR GBDT training "
+                       f"({sparse['rows']} rows x "
+                       f"{sparse['features']} feat, density "
+                       f"{sparse['density']:g}, "
+                       f"{sparse['iters']} iters)"),
+            "value": sparse["routes"]["csr"]["rows_per_s"],
+            "unit": "rows/s",
+        }))
+        return
 
     if args.lrb_stream:
         from lightgbm_tpu.ops import autotune as _autotune

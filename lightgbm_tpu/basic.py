@@ -80,7 +80,11 @@ def _data_to_2d(data, feature_name="auto", categorical_feature="auto"):
             # _data_from_pandas maps it back before binning)
             X[:, i] = np.where(codes < 0, np.nan, codes)
     elif _is_scipy_sparse(data):
-        X = np.asarray(data.todense(), np.float64)
+        # CSR-native: scipy input stays O(nnz) (io/sparse.py); the
+        # densify-vs-CSR route decision is TpuDataset's (it has the
+        # config), and the predict paths densify in bounded chunks
+        from .io.sparse import SparseMatrix
+        X = SparseMatrix.from_scipy(data)
     else:
         X = np.asarray(data, np.float64)
         if X.ndim == 1:
@@ -839,10 +843,13 @@ class _InnerPredictor:
     def num_total_iteration(self) -> int:
         return self._gbdt.current_iteration
 
-    def init_score_for(self, X: np.ndarray) -> np.ndarray:
+    def init_score_for(self, X) -> np.ndarray:
         """Raw predictions flattened class-major — the init_score layout
         (metadata.cpp init_score_ is [class][row])."""
-        raw = self._gbdt.predict_raw(np.asarray(X, np.float64))
+        from .io.sparse import SparseMatrix
+        if not isinstance(X, SparseMatrix):
+            X = np.asarray(X, np.float64)
+        raw = self._gbdt.predict_raw(X)
         if raw.ndim == 2:          # [N, K] -> class-major flat
             return raw.T.reshape(-1).astype(np.float64)
         return raw.astype(np.float64)

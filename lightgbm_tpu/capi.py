@@ -20,6 +20,7 @@ import numpy as np
 from .basic import _DEFAULT_METRIC, _resolve_metric_names
 from .config import Config, param_dict_to_str
 from .io.dataset import Metadata, TpuDataset
+from .io.sparse import SparseMatrix, warn_dense_cliff
 from .metrics import create_metrics
 from .models.boosting import create_boosting
 from .objectives import create_objective
@@ -55,10 +56,13 @@ class _DatasetHandle:
     (or reference link) construction needs it (c_api.cpp Dataset
     creation is likewise deferred to ConstructFromSampleData)."""
 
-    def __init__(self, X: np.ndarray, cfg: Config,
+    def __init__(self, X, cfg: Config,
                  reference: Optional["_DatasetHandle"] = None,
                  ring=None):
-        self.X = np.asarray(X, np.float64)
+        # CSR-native input (io/sparse.py) stays sparse end to end; the
+        # route decision (densify vs CSR) is TpuDataset's at construct
+        self.X = (X if isinstance(X, SparseMatrix)
+                  else np.asarray(X, np.float64))
         self.cfg = cfg
         self.reference = reference
         self.fields: Dict[str, np.ndarray] = {}
@@ -101,10 +105,15 @@ def _parse_cat_spec(cfg: Config) -> List[int]:
 
 def _csc_to_dense(col_ptr, indices, data, num_row: int,
                   num_col: int) -> np.ndarray:
-    X = np.zeros((int(num_row), int(num_col)), np.float64)
+    """Explicit dense fallback for column-sparse input — the >4 GiB
+    cliff guard (io/sparse.py warn_dense_cliff) fires HERE and in
+    ``_csr_to_dense``, through one shared helper (the CSC path used to
+    lack it)."""
     col_ptr = np.asarray(col_ptr, np.int64)
     indices = np.asarray(indices, np.int64)
     data = np.asarray(data, np.float64)
+    warn_dense_cliff(int(num_row), int(num_col), int(data.size))
+    X = np.zeros((int(num_row), int(num_col)), np.float64)
     for j in range(int(num_col)):
         sl = slice(int(col_ptr[j]), int(col_ptr[j + 1]))
         X[indices[sl], j] = data[sl]
@@ -112,23 +121,14 @@ def _csc_to_dense(col_ptr, indices, data, num_row: int,
 
 
 def _csr_to_dense(indptr, indices, data, num_col: int) -> np.ndarray:
+    """Explicit dense fallback for row-sparse input (push-rows blocks
+    and callers that want the matrix); genuinely sparse datasets take
+    the CSR-native route (io/sparse.py) and never come through here."""
     indptr = np.asarray(indptr, np.int64)
     indices = np.asarray(indices, np.int64)
     data = np.asarray(data, np.float64)
     n = len(indptr) - 1
-    # dense-only engine (SURVEY §7): the reference keeps CSR through
-    # sampling (c_api.cpp:506); here sparse input densifies, which is a
-    # memory CLIFF for genuinely sparse data — warn before allocating
-    # (EFB re-compresses exclusive columns once binned)
-    dense_gb = n * num_col * 8 / 2 ** 30
-    if dense_gb > 4.0:
-        nnz = data.size
-        log.warning(
-            "densifying %dx%d sparse input to %.1f GiB (nnz=%d, "
-            "density %.4f): the TPU engine is dense-only; consider "
-            "enable_bundle=true (EFB) or fewer columns",
-            n, num_col, dense_gb, nnz,
-            nnz / max(n * num_col, 1))
+    warn_dense_cliff(n, int(num_col), int(data.size))
     X = np.zeros((n, num_col), np.float64)
     rows = np.repeat(np.arange(n), np.diff(indptr))
     X[rows, indices[:len(rows)]] = data[:len(rows)]
@@ -166,10 +166,12 @@ def LGBM_DatasetCreateFromMat(data, data_type=C_API_DTYPE_FLOAT64,
 def LGBM_DatasetCreateFromCSR(indptr, indptr_type, indices, data,
                               data_type, nindptr, nelem, num_col,
                               parameters="", reference=None):
-    """c_api.cpp:268 LGBM_DatasetCreateFromCSR (densified: the engine's
-    HBM layout is dense by design, io/dataset.py)."""
-    X = _csr_to_dense(indptr, indices, data, int(num_col))
-    return _DatasetHandle(X, _params_to_config(parameters), reference)
+    """c_api.cpp:268 LGBM_DatasetCreateFromCSR — CSR-native: the input
+    stays O(nnz) on the host (io/sparse.py SparseMatrix); TpuDataset
+    densifies only when density exceeds ``sparse_threshold`` (the
+    reference keeps CSR through sampling too, c_api.cpp:506)."""
+    sm = SparseMatrix.from_csr(indptr, indices, data, int(num_col))
+    return _DatasetHandle(sm, _params_to_config(parameters), reference)
 
 
 def LGBM_DatasetCreateFromFile(filename: str, parameters="",
@@ -361,9 +363,11 @@ def LGBM_BoosterPredictForCSR(handle: _BoosterHandle, indptr, indptr_type,
                               indices, data, data_type, nindptr, nelem,
                               num_col, predict_type=C_API_PREDICT_NORMAL,
                               num_iteration=-1, parameter=""):
-    """c_api.cpp:878."""
-    X = _csr_to_dense(indptr, indices, data, int(num_col))
-    return _predict(handle.gbdt, X, predict_type, num_iteration)
+    """c_api.cpp:878 — CSR predict densifies in bounded row chunks
+    inside the predict paths (models/gbdt.py), never the whole
+    matrix."""
+    sm = SparseMatrix.from_csr(indptr, indices, data, int(num_col))
+    return _predict(handle.gbdt, sm, predict_type, num_iteration)
 
 
 def LGBM_BoosterPredictForFile(handle: _BoosterHandle, data_filename,
@@ -470,11 +474,12 @@ def LGBM_DatasetCreateFromCSC(col_ptr, col_ptr_type, indices, data,
                               data_type, ncol_ptr, nelem, num_row,
                               parameters="", reference=None
                               ) -> _DatasetHandle:
-    """c_api.cpp:390 — column-sparse input, densified (the engine's
-    layout is dense HBM by design, io/dataset.py)."""
-    X = _csc_to_dense(col_ptr, indices, data, num_row,
-                      int(ncol_ptr) - 1)
-    return _DatasetHandle(X, _params_to_config(parameters), reference)
+    """c_api.cpp:390 — column-sparse input, transposed to the CSR
+    representation in O(nnz) (io/sparse.py); the dense fallback is
+    TpuDataset's above-threshold route."""
+    sm = SparseMatrix.from_csc(col_ptr, indices, data, int(num_row),
+                               int(ncol_ptr) - 1)
+    return _DatasetHandle(sm, _params_to_config(parameters), reference)
 
 
 def LGBM_DatasetCreateFromMats(nmat, mats, data_type, nrows, ncol,
@@ -740,10 +745,11 @@ def LGBM_BoosterPredictForCSC(handle: _BoosterHandle, col_ptr,
                               ncol_ptr, nelem, num_row,
                               predict_type=C_API_PREDICT_NORMAL,
                               num_iteration=-1, parameter=""):
-    """c_api.cpp:1100 — densified column-sparse predict."""
-    X = _csc_to_dense(col_ptr, indices, data, num_row,
-                      int(ncol_ptr) - 1)
-    return _predict(handle.gbdt, X, predict_type, num_iteration)
+    """c_api.cpp:1100 — column-sparse predict via the CSR
+    representation, densified in bounded row chunks."""
+    sm = SparseMatrix.from_csc(col_ptr, indices, data, int(num_row),
+                               int(ncol_ptr) - 1)
+    return _predict(handle.gbdt, sm, predict_type, num_iteration)
 
 
 # ---------------------------------------------------------------------------

@@ -500,6 +500,21 @@ class Config:
     # device ingest path is active. -1 = auto (on); 0 = off (full
     # padded-chunk re-ingest every window); 1 = force on.
     tpu_lrb_ring: int = -1
+    # sparse histogram kernel tier (ops/hist_wave.py
+    # wave_histogram_sparse): wave histograms accumulate by
+    # scatter/segment-sum over the nnz explicit entries (plus a
+    # default-bin completion from per-leaf totals) instead of the
+    # dense one-hot pass — O(nnz) histogram work for CSR-native
+    # datasets (io/sparse.py). -1 = auto: engages when the dataset
+    # carries sparse coordinates, density clears the autotune rule
+    # (ops/autotune.py tune_hist_tier) AND tpu_quantized_hist is on —
+    # integer accumulation is order-free, so the tier is BIT-equal to
+    # the dense tier; 0 = off (dense tier even for CSR input);
+    # 1 = force wherever structurally possible (serial learner, no EFB
+    # bundles) — with f32 accumulation the default-bin completion
+    # reassociates sums, so final-ulp histogram drift vs the dense
+    # tier is possible (documented in docs/Design.md §5f).
+    tpu_sparse: int = -1
 
     def __post_init__(self):
         self._raw_params: Dict[str, str] = {}
@@ -572,9 +587,6 @@ class Config:
         "num_threads": "XLA owns intra-op parallelism",
         "histogram_pool_size": "histogram pool lives in HBM "
                                "(preallocated, no LRU needed)",
-        "is_enable_sparse": "dense-only HBM layout by design "
-                            "(io/dataset.py)",
-        "sparse_threshold": "dense-only HBM layout by design",
         "gpu_platform_id": "device selection is jax's",
         "gpu_device_id": "device selection is jax's",
         "gpu_use_dp": "see tpu_use_dp",
@@ -712,6 +724,16 @@ class Config:
             log.warning("tpu_lrb_ring=%d is not one of -1/0/1; using "
                         "-1 (auto)", self.tpu_lrb_ring)
             self.tpu_lrb_ring = -1
+        if self.tpu_sparse not in (-1, 0, 1):
+            log.warning("tpu_sparse=%d is not one of -1/0/1; using -1 "
+                        "(auto)", self.tpu_sparse)
+            self.tpu_sparse = -1
+        if not 0.0 < self.sparse_threshold <= 1.0:
+            # the CSR route gate (io/sparse.py route_sparse): the
+            # implicit fraction must reach this threshold
+            log.warning("sparse_threshold=%g is outside (0, 1]; using "
+                        "0.8", self.sparse_threshold)
+            self.sparse_threshold = 0.8
         if self.tpu_metrics_interval_s <= 0:
             log.warning("tpu_metrics_interval_s=%g is not positive; "
                         "using 5.0", self.tpu_metrics_interval_s)

@@ -162,6 +162,16 @@ class TpuDataset:
         self.member_bundle: Optional[np.ndarray] = None
         self.member_offset: Optional[np.ndarray] = None
         self.bundle_width = 0
+        # CSR-native state (io/sparse.py): set by the sparse
+        # construction route. ``sparse_coords`` holds the
+        # zero-suppressed (code, inner feature, row) planes — numpy on
+        # the host path, jax arrays when the sparse device ingest
+        # assembled them — retained only when the sparse histogram
+        # tier may consume them (sparse.want_coords).
+        self.sparse_nnz = 0
+        self.sparse_density: Optional[float] = None
+        self.sparse_coords = None
+        self.sparse_zero_bins: Optional[np.ndarray] = None
 
     # -- construction -------------------------------------------------------
 
@@ -185,6 +195,12 @@ class TpuDataset:
         # must land in the same buffer the training spans will
         from ..obs import trace
         trace.ensure_from_config(self.config)
+        from .sparse import SparseMatrix
+        if isinstance(X, SparseMatrix):
+            return self._construct_from_sparse(
+                X, metadata, categorical=categorical,
+                reference=reference, feature_names=feature_names,
+                mappers=mappers)
         X = np.asarray(X)
         if X.dtype not in (np.float32, np.float64):
             X = X.astype(np.float64)
@@ -228,6 +244,173 @@ class TpuDataset:
             with timing.phase("binning/efb"):
                 self._apply_efb()
         return self
+
+    def _construct_from_sparse(self, sm, metadata: Metadata,
+                               categorical: Sequence[int] = (),
+                               reference: Optional["TpuDataset"] = None,
+                               feature_names: Optional[List[str]] = None,
+                               mappers: Optional[List[BinMapper]] = None
+                               ) -> "TpuDataset":
+        """CSR-native construction (io/sparse.py): the host never
+        materializes the [N, F] float64 matrix. Mappers sample straight
+        from CSR (bit-identical to the densified path's), binning is
+        O(nnz) — device-side through the streamed sparse ingest
+        (io/ingest.py SparseDeviceBinner) or a host scatter into the
+        bin-storage tier — and datasets where EFB actually bundles
+        build the host bin matrix (uint8, not float64) so the bundling
+        decision and bundled matrix stay bit-identical to the
+        densified path. Above ``sparse_threshold`` density the input
+        takes the explicit dense fallback (the one place the densify
+        cliff warning still fires on this path)."""
+        from ..obs import registry as obs
+        from ..utils import timing
+        from . import sparse as sp
+        cfg = self.config
+        n, nf = sm.shape
+        if not sp.route_sparse(cfg, sm):
+            obs.counter("sparse/route_dense").add(1)
+            log.info("sparse input density %.4f is above the CSR route "
+                     "gate (1 - sparse_threshold = %g): densifying",
+                     sm.density, 1.0 - cfg.sparse_threshold)
+            return self.construct_from_matrix(
+                sm.to_dense(warn=True), metadata,
+                categorical=categorical, reference=reference,
+                feature_names=feature_names, mappers=mappers)
+        obs.counter("sparse/route_sparse").add(1)
+        obs.counter("sparse/nnz_rows").add(sm.nnz)
+        obs.gauge("sparse/density").set(sm.density)
+        self.num_data = n
+        self.num_total_features = nf
+        self.metadata = metadata
+        self.metadata.check_or_partition(n)
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(nf)])
+        if reference is not None:
+            self._reference = reference
+            self.mappers = reference.mappers
+            self.used_feature_map = reference.used_feature_map
+            self.real_to_inner = reference.real_to_inner
+            self.max_bin_global = reference.max_bin_global
+            self.feature_names = reference.feature_names
+            self.num_total_features = reference.num_total_features
+        elif mappers is not None:
+            self._set_mappers(mappers)
+        else:
+            with timing.phase("binning/find_bins"):
+                self._set_mappers(sp.find_column_mappers_sparse(
+                    sm, cfg, set(categorical)))
+        self.sparse_nnz = sm.nnz
+        self.sparse_density = sm.density
+        if self.mappers:
+            self.sparse_zero_bins = sp.zero_bins(self.mappers)
+        # coords feed the sparse histogram tier — train sets only (the
+        # grower histograms training rows; valid rows ride as weight-0
+        # passengers of the dense matrix either way)
+        keep_coords = (sp.want_coords(cfg, sm.density)
+                       and reference is None)
+        efb_possible = mappers is None and reference is None
+        with timing.phase("binning/bin_matrix") as ph:
+            self._bin_sparse(sm, keep_coords, efb_possible)
+            if self.bins_t_dev is not None:
+                ph.watch(self.bins_t_dev)
+        if mappers is None and self.bins is not None:
+            with timing.phase("binning/efb"):
+                self._apply_efb()
+            if self.bundles is not None:
+                # the sparse tier never composes with EFB bundles
+                # (models/gbdt.py) — binned coordinates of UNBUNDLED
+                # member features would be the wrong layout anyway
+                self.sparse_coords = None
+        return self
+
+    def _bin_sparse(self, sm, keep_coords: bool,
+                    efb_possible: bool) -> None:
+        """Bin a CSR matrix: streamed sparse device ingest when enabled
+        and reproducible, else an O(nnz) host scatter into the
+        bin-storage tier. Either way the dense float64 [N, F] never
+        exists."""
+        from ..obs import registry as obs
+        from . import sparse as sp
+        self.bins_t_dev = None
+        self.bins_t_dev_pad = 0
+        self.bins = None
+        n = sm.shape[0]
+        if self._sparse_device_ok(sm, efb_possible):
+            from .ingest import IngestUnsupported, SparseDeviceBinner
+            try:
+                binner = SparseDeviceBinner(
+                    self.mappers, self.used_feature_map, self.config)
+            except IngestUnsupported as e:
+                log.debug("sparse device ingest unavailable (%s); "
+                          "host scatter", e)
+            else:
+                self.bins_t_dev, coords = binner.bin_matrix_sparse(
+                    sm, want_coords=keep_coords)
+                if keep_coords:
+                    self.sparse_coords = coords
+                log.info("sparse device ingest: %d rows x %d features "
+                         "binned on device from nnz=%d (density %.4f) "
+                         "in %d-row chunks", n, self.num_features,
+                         sm.nnz, sm.density, binner.chunk_rows)
+                return
+        # host path: one O(nnz) entry binning serves both the bin
+        # matrix scatter and (when wanted) the retained coordinates
+        dtype = self.bin_dtype()
+        if not self.mappers:
+            self.bins = np.zeros((n, 1), dtype)
+            return
+        codes, feat, rows = sp.bin_entries(sm, self.mappers,
+                                           self.used_feature_map)
+        bins = np.empty((n, len(self.mappers)), dtype)
+        bins[:] = self.sparse_zero_bins.astype(dtype)[None, :]
+        bins[rows, feat] = codes.astype(dtype)
+        self.bins = bins
+        if keep_coords:
+            self.sparse_coords = (codes, feat, rows)
+        obs.counter("ingest/rows_host").add(n)
+
+    def _sparse_device_ok(self, sm, efb_possible: bool) -> bool:
+        """Gate for the streamed sparse device path — the sparse twin
+        of ``_device_ingest_ok``: config-enabled, usable reproducible
+        mappers, no EFB interaction, and no row-sharding mesh (the
+        sparse route has no sharded ingest yet; sharded learners get
+        the host bins placed under the mesh at booster init)."""
+        from .ingest import ingest_enabled, ingest_mesh, mappers_supported
+        if not ingest_enabled(self.config):
+            return False
+        if not self.mappers:
+            return False
+        if not mappers_supported(self.mappers):
+            return False
+        ref = self._reference
+        if ref is not None and ref.bundles is not None:
+            return False
+        if ref is None and ingest_mesh(self.config) is not None:
+            return False
+        if efb_possible and self._efb_would_bundle_sparse(sm):
+            log.info("EFB bundles this sparse data; using the host "
+                     "scatter so bundling stays bit-identical (set "
+                     "enable_bundle=false for device sparse ingest)")
+            return False
+        return True
+
+    def _efb_would_bundle_sparse(self, sm) -> bool:
+        """``_efb_would_bundle`` for CSR input: bin the SAME rng(3) row
+        sample find_bundles would draw (O(nnz of the sample)) and ask
+        ``would_bundle`` directly — identical verdict to the densified
+        path's, binning is row-wise."""
+        cfg = self.config
+        if not cfg.enable_bundle or self.num_features <= 1:
+            return False
+        from .efb import sample_rows_for_probe, would_bundle
+        from .sparse import host_bins_from_sparse
+        idx = sample_rows_for_probe(sm.shape[0])
+        sample = sm if idx is None else sm.take_rows(idx)
+        return would_bundle(
+            host_bins_from_sparse(sample, self.mappers,
+                                  self.used_feature_map,
+                                  self.bin_dtype()),
+            self.mappers, cfg.max_conflict_rate)
 
     def _construct_mappers(self, X: np.ndarray, categorical: set) -> None:
         self._set_mappers(find_column_mappers(X, self.config, categorical))
@@ -482,9 +665,12 @@ class TpuDataset:
                          else self.mappers[inner].feature_info())
         return infos
 
-    def create_valid(self, X: np.ndarray, metadata: Metadata) -> "TpuDataset":
+    def create_valid(self, X, metadata: Metadata) -> "TpuDataset":
+        from .sparse import SparseMatrix
+        if not isinstance(X, SparseMatrix):
+            X = np.asarray(X)
         v = TpuDataset(self.config)
-        v.construct_from_matrix(np.asarray(X), metadata, reference=self)
+        v.construct_from_matrix(X, metadata, reference=self)
         # CreateValid's contract (dataset.cpp:368): the valid set BINS
         # with the train set's mappers, never re-derives them — the
         # streamed ingest path rides the same guarantee

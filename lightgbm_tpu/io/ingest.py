@@ -789,6 +789,278 @@ class DeviceBinner:
         return IngestStream(self)
 
 
+# -- CSR-native sparse ingest -------------------------------------------------
+
+# sparse chunks carry a VARIABLE entry count: pad each plane set to a
+# power-of-two bucket so the compiled chunk kernel is shared across
+# chunks/windows (the step-cache shape-bucketing discipline); sentinel
+# entries carry feature index F and are dropped by the device scatter
+_SPARSE_ENTRY_FLOOR = 2048
+
+
+def sparse_entry_bucket(e: int) -> int:
+    """Padded entry-plane length for ``e`` explicit entries — the
+    shared pow2 shape-taper, floored so tiny chunks share one compiled
+    kernel."""
+    from ..ops.step_cache import pow2_bucket
+    return pow2_bucket(e, _SPARSE_ENTRY_FLOOR)
+
+
+class SparseDeviceBinner(DeviceBinner):
+    """Device-side binning of CSR chunks riding the same
+    double-buffered prefetch pipeline as the dense ``DeviceBinner``.
+
+    The host half (worker thread) slices a row-chunk of the CSR matrix
+    and keys its explicit VALUES exactly like the dense prep — the
+    sortable-integer f64 hi/lo planes of the module docstring — with
+    the entry COLUMN/ROW indices as two more planes on the transfer
+    thunk. The device half runs the same branchless lower-bound search
+    PER ENTRY (bounds row gathered by each entry's feature) and
+    scatters the resulting bin codes over a zero-bin-filled ``[F, C]``
+    block — the dense feature-major chunk layout, assembled without any
+    host [N, F] matrix at any width. Bit-exact vs the host
+    ``value_to_bin`` by the same argument as the dense kernel: the key
+    comparisons are identical, and implicit cells take the
+    host-computed ``zero_bins`` constants.
+
+    Categorical entries are coded on the host in the prep thunk (few,
+    cheap — the dense path already host-truncates categoricals).
+
+    ``bin_matrix_sparse`` optionally also returns the zero-suppressed
+    (code, feature, row) coordinate planes — device-resident, already
+    binned — which feed the sparse histogram tier
+    (ops/hist_wave.py ``wave_histogram_sparse``)."""
+
+    def __init__(self, mappers: List[BinMapper],
+                 used_feature_map: np.ndarray, config) -> None:
+        super().__init__(mappers, used_feature_map, config, np.float64)
+        import jax.numpy as jnp
+        from .sparse import zero_bins
+        self._zb_dev = jnp.asarray(zero_bins(mappers))
+        # real column -> (inner feature, numerical-bounds row) lookups,
+        # built lazily at the matrix width (entries on TRIVIAL columns
+        # must be dropped, and those columns sit outside used_feature_map)
+        self._lut_nf = -1
+        self._lut_inner = None
+        self._lut_numpos = None
+        self._inner_is_cat = np.zeros(len(mappers), bool)
+        self._inner_is_cat[self.cat_inner] = True
+        self._sparse_fn = self._build_sparse_chunk_fn()
+
+    def _lut(self, nf: int):
+        if self._lut_nf != nf:
+            used = np.asarray(
+                [int(c) for c in np.concatenate(
+                    [self.num_cols, self.cat_cols])] or [], np.int64)
+            inner_of = np.concatenate(
+                [self.num_inner, self.cat_inner]).astype(np.int64) \
+                if len(used) else np.zeros(0, np.int64)
+            real2inner = np.full(nf, -1, np.int64)
+            real2inner[used] = inner_of
+            inner2numpos = np.full(len(self.mappers), 0, np.int64)
+            inner2numpos[self.num_inner] = np.arange(
+                len(self.num_inner))
+            self._lut_nf = nf
+            self._lut_inner = real2inner
+            self._lut_numpos = inner2numpos
+        return self._lut_inner, self._lut_numpos
+
+    # -- device kernel -------------------------------------------------------
+
+    def _build_sparse_chunk_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        Bp = self._Bp
+        bhi, blo = self._bhi, self._blo
+        nan_bin = self._nan_bin
+        zb = self._zb_dev
+        out_dtype = self.out_dtype
+        C = self.chunk_rows
+        F = len(self.mappers)
+        Fn = len(self.num_inner)
+
+        def lower_bound_entries(xh, xl, fb):
+            """Count of bounds < x per entry, bounds row gathered by
+            the entry's feature — the dense kernel's uniform binary
+            search, per entry instead of per (row, feature)."""
+            pos = jnp.zeros(xh.shape, jnp.int32)
+            step = Bp
+            while step > 1:
+                step //= 2
+                idx = pos + (step - 1)
+                gh = bhi[fb, idx]
+                go = gh < xh
+                gl = blo[fb, idx]
+                go = go | ((gh == xh) & (gl < xl))
+                pos = jnp.where(go, pos + step, pos)
+            return pos
+
+        def chunk(r0, xa, xb, nan, nb, ni, nr, ci, cr, cc):
+            """One CSR chunk -> ([F, C] bins, per-entry coords).
+
+            xa/xb: f64 hi/lo key planes of the numerical entry values;
+            nan: host NaN mask; nb: bounds-row index; ni/nr: inner
+            feature + local row per numerical entry; ci/cr/cc: inner
+            feature / local row / host-coded bin per categorical
+            entry. Sentinel (pad) entries carry feature F — out of
+            bounds for every scatter, dropped by mode="drop"."""
+            out = jnp.broadcast_to(
+                zb.astype(out_dtype)[:, None], (F, C))
+            if Fn and xa.shape[0]:
+                pos = lower_bound_entries(xa, xb, nb)
+                code_n = jnp.where(nan & (nan_bin[nb] >= 0),
+                                   nan_bin[nb], pos)
+            else:
+                code_n = jnp.zeros((0,), jnp.int32)
+            out = out.at[ni, nr].set(code_n.astype(out_dtype),
+                                     mode="drop")
+            if cc.shape[0]:
+                out = out.at[ci, cr].set(cc.astype(out_dtype),
+                                         mode="drop")
+            codes = jnp.concatenate([code_n, cc]).astype(jnp.int32)
+            feat = jnp.concatenate([ni, ci])
+            rows = jnp.concatenate([nr, cr]) + r0
+            return out, codes, feat, rows
+
+        return jax.jit(chunk)
+
+    # -- host-side chunk prep ------------------------------------------------
+
+    def _prep_sparse_chunk(self, sm, r0: int, r1: int):
+        from ..utils import faults
+        if faults.active():
+            faults.check("ingest.prep", context=f"{r1 - r0} rows")
+        with trace.span("ingest/prep_chunk", cat="ingest",
+                        args={"rows": int(r1 - r0), "sparse": True}):
+            return self._prep_sparse_chunk_inner(sm, r0, r1)
+
+    def _prep_sparse_chunk_inner(self, sm, r0: int, r1: int):
+        sub = sm.row_slice(r0, r1)
+        real2inner, inner2numpos = self._lut(sm.shape[1])
+        inner = real2inner[sub.cols]
+        lrows = sub.rows().astype(np.int32)
+        F = len(self.mappers)
+        kept = inner >= 0
+        is_cat = np.zeros(len(inner), bool)
+        is_cat[kept] = self._inner_is_cat[inner[kept]]
+        numm = kept & ~is_cat
+        catm = kept & is_cat
+
+        # numerical planes: keyed values + indices (NaN -> key of +0.0
+        # with the mask riding separately, -0.0 normalized — the dense
+        # prep's exact recipe)
+        v = sub.data[numm]
+        nanm = np.isnan(v)
+        v = np.where(nanm, 0.0, v) + 0.0
+        xa, xb = _keys64_host(v)
+        nb = inner2numpos[inner[numm]].astype(np.int32)
+        ni = inner[numm].astype(np.int32)
+        nr = lrows[numm]
+        if len(self.num_inner):
+            En = sparse_entry_bucket(len(v))
+            pad = En - len(v)
+            if pad:
+                xa = np.pad(xa, (0, pad))
+                xb = np.pad(xb, (0, pad))
+                nanm = np.pad(nanm, (0, pad))
+                nb = np.pad(nb, (0, pad))
+                ni = np.pad(ni, (0, pad), constant_values=F)
+                nr = np.pad(nr, (0, pad))
+
+        # categorical planes: host-coded (few columns, cheap — the
+        # dense path host-truncates categoricals the same way)
+        if len(self.cat_inner):
+            cis, crs, ccs = [], [], []
+            for i in self.cat_inner:
+                m2 = catm & (inner == i)
+                if not m2.any():
+                    continue
+                ccs.append(np.asarray(
+                    self.mappers[i].value_to_bin(sub.data[m2]),
+                    np.int32))
+                cis.append(np.full(int(m2.sum()), i, np.int32))
+                crs.append(lrows[m2])
+            ci = (np.concatenate(cis) if cis else np.zeros(0, np.int32))
+            cr = (np.concatenate(crs) if crs else np.zeros(0, np.int32))
+            cc = (np.concatenate(ccs) if ccs else np.zeros(0, np.int32))
+            Ec = sparse_entry_bucket(len(cc))
+            pad = Ec - len(cc)
+            ci = np.pad(ci, (0, pad), constant_values=F)
+            cr = np.pad(cr, (0, pad))
+            cc = np.pad(cc, (0, pad))
+        else:
+            ci = cr = cc = np.zeros(0, np.int32)
+        return (r0, (xa, xb, nanm, nb, ni, nr, ci, cr, cc),
+                r1 - r0)
+
+    # -- driver --------------------------------------------------------------
+
+    def _submit_sparse(self, prepped):
+        import jax
+        import jax.numpy as jnp
+        r0, arrs, k = prepped
+        nbytes = sum(int(a.nbytes) for a in arrs)
+        from ..utils import faults, retry
+
+        def put():
+            if faults.active():
+                faults.check("ingest.device_put",
+                             context=f"{nbytes} bytes")
+            return jax.device_put(arrs)
+
+        with trace.span("ingest/chunk", cat="ingest",
+                        args={"rows": int(k), "bytes": nbytes,
+                              "sparse": True}):
+            with timing.phase("binning/device_xfer"):
+                arrs = retry.call(put, what="sparse ingest device_put",
+                                  policy=self.retry_policy)
+            obs.counter("ingest/h2d_bytes").add(nbytes)
+            obs.counter("ingest/h2d_chunks").add(1)
+            obs.counter("ingest/rows_device").add(k)
+            out, codes, feat, rows = self._sparse_fn(jnp.int32(r0),
+                                                     *arrs)
+        if k < self.chunk_rows:
+            out = out[:, :k]
+        return out, (codes, feat, rows)
+
+    def bin_matrix_sparse(self, sm, want_coords: bool = False):
+        """CSR matrix -> ([F, N] device bins, coords or None) with the
+        double-buffered pipeline: the worker keys chunk k+1's entry
+        planes while chunk k's transfer + kernel are in flight.
+        ``coords`` = (codes, feat, rows) device planes over every
+        chunk's entries — sentinel (pad) entries carry feature F, which
+        every downstream scatter drops."""
+        import jax.numpy as jnp
+        n = sm.shape[0]
+        C = self.chunk_rows
+        starts = list(range(0, n, C))
+
+        def thunk(r0):
+            return lambda: self._prep_sparse_chunk(
+                sm, r0, min(r0 + C, n))
+
+        outs, codes, feats, rows = [], [], [], []
+        for p in prefetch((thunk(r0) for r0 in starts),
+                          what="sparse ingest chunk",
+                          policy=self.retry_policy):
+            block, co = self._submit_sparse(p)
+            outs.append(block)
+            if want_coords:
+                codes.append(co[0])
+                feats.append(co[1])
+                rows.append(co[2])
+        bins_t = outs[0] if len(outs) == 1 else jnp.concatenate(outs, 1)
+        coords = None
+        if want_coords:
+            coords = (jnp.concatenate(codes), jnp.concatenate(feats),
+                      jnp.concatenate(rows))
+        log.debug("sparse device ingest: %d rows x %d features "
+                  "(nnz=%d) in %d chunk(s) of %d rows", n,
+                  len(self.mappers), sm.nnz, len(outs), C)
+        return bins_t, coords
+
+
 class IngestStream:
     """Feed-driven variant for streaming loaders (two-round text
     loading): rows arrive in parser-sized blocks, are repacked to the

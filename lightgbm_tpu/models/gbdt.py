@@ -229,6 +229,11 @@ class GBDT:
             obs.counter("transfer/h2d_bins_bytes").add(int(bins_t.nbytes))
             obs.counter("transfer/h2d_uploads").add(1)
         self._train_width = bins_t.shape[1]
+        # sparse histogram tier: device coordinate planes, bucketed so
+        # same-geometry sparse boosters (the sliding-window pattern)
+        # share one compiled step (ops/step_cache.py bucket_entries)
+        self._sparse_dev = (self._build_sparse_planes()
+                            if self._grower_cfg.sparse_hist else None)
         self._valid_row_slices: List[tuple] = []
         self._n_total = self._n + self._pad_rows
         self._full_mask_dev = self._place_rows(np.concatenate(
@@ -327,6 +332,36 @@ class GBDT:
         # accumulation (tpu_use_dp) needs 5W <= 128 -> W = 24; single
         # bf16 fused needs 4W <= 128 -> W = 32.
         quant = cfg.tpu_quantized_hist
+        # sparse histogram tier (config.tpu_sparse, io/sparse.py):
+        # wave histograms scatter over the dataset's retained nnz
+        # coordinates instead of the dense one-hot pass. Structural
+        # gates here (serial learner, no EFB bundles, coordinates
+        # present); the (density, exactness) rule is the autotuner's
+        # (ops/autotune.py tune_hist_tier). Decided BEFORE the
+        # count-proxy gate — the tiers are mutually exclusive.
+        td_s = self.train_data
+        sparse_tier = False
+        if (getattr(td_s, "sparse_coords", None) is not None
+                and mode == "serial" and not self._use_bundles):
+            from ..ops.autotune import tune_hist_tier
+            sparse_tier = tune_hist_tier(
+                requested=cfg.tpu_sparse,
+                density=td_s.sparse_density or 0.0,
+                nnz=td_s.sparse_nnz,
+                F=max(td_s.num_features, 1),
+                B=max(td_s.max_bin_global, 2), W=0, quant=quant)
+        elif (cfg.tpu_sparse == 1
+              and getattr(td_s, "sparse_density", None) is not None):
+            log.warning("tpu_sparse=1 needs the serial tree learner "
+                        "without EFB bundles and a CSR-constructed "
+                        "train set carrying coordinates; using the "
+                        "dense histogram tier")
+        if (getattr(self, "_scores", None) is not None
+                and hasattr(self, "_grower_cfg")):
+            # reset_parameter re-entry: the coordinate planes were
+            # built (or not) at init — a flipped knob cannot
+            # materialize them mid-life
+            sparse_tier = self._grower_cfg.sparse_hist
         # count-proxy (see config.tpu_count_proxy): int8-only, needs the
         # fused kernel's default seams — serial/data modes, no EFB
         # bundles, no forced splits (voting reads LOCAL count sums in
@@ -338,6 +373,7 @@ class GBDT:
                  and not self._use_bundles
                  and not cfg.forcedsplits_filename
                  and not hp.has_cat
+                 and not sparse_tier
                  and cfg.tpu_count_proxy != 0)
         if cfg.tpu_count_proxy == 1 and not proxy:
             log.warning("tpu_count_proxy needs tpu_quantized_hist with "
@@ -570,7 +606,8 @@ class GBDT:
             forced=self._parse_forced_splits(),
             count_proxy=proxy,
             packed4=packed4,
-            quant_psum=quant_psum)
+            quant_psum=quant_psum,
+            sparse_hist=sparse_tier)
         self._grower_cfg = gcfg
         hist_fn = None
         efb_feature = None
@@ -884,6 +921,47 @@ class GBDT:
         return jnp.bitwise_or(bins_t[0::2],
                               jnp.left_shift(bins_t[1::2], jnp.uint8(4)))
 
+    def _build_sparse_planes(self):
+        """(codes, feat, row, zero_bins) device planes for the sparse
+        histogram tier (ops/hist_wave.py wave_histogram_sparse), padded
+        to the nnz bucket with sentinel entries (feature == padded F,
+        dropped by every scatter). Works off host coords (the host
+        scatter path) or the device planes sparse ingest assembled —
+        either way the ingest's own sentinels (feature == unpadded F)
+        are remapped past the padded width first."""
+        from ..obs import registry as obs
+        from ..ops import step_cache
+        td = self.train_data
+        f = max(td.num_features, 1)
+        codes = jnp.asarray(td.sparse_coords[0]).astype(jnp.int32)
+        feat = jnp.asarray(td.sparse_coords[1]).astype(jnp.int32)
+        rows = jnp.asarray(td.sparse_coords[2]).astype(jnp.int32)
+        feat = jnp.where(feat >= f, jnp.int32(self._f_pad), feat)
+        E = int(codes.shape[0])
+        Ep = (step_cache.bucket_entries(E, self.config.tpu_row_bucket)
+              if self._cache_eligible else E)
+        pad = Ep - E
+        if pad:
+            codes = jnp.concatenate([codes, jnp.zeros(pad, jnp.int32)])
+            feat = jnp.concatenate(
+                [feat, jnp.full(pad, self._f_pad, jnp.int32)])
+            rows = jnp.concatenate([rows, jnp.zeros(pad, jnp.int32)])
+        zb = np.zeros(self._f_pad, np.int32)
+        zbs = td.sparse_zero_bins
+        zb[:len(zbs)] = zbs
+        obs.counter("sparse/hist_tier_sparse").add(1)
+        log.info("sparse histogram tier: %d coordinate entries "
+                 "(bucketed to %d) over %d features", E, Ep,
+                 self._f_pad)
+        return (codes, feat, rows, jnp.asarray(zb))
+
+    def _step_bins(self):
+        """The fused step's bins argument: the dense matrix, paired
+        with the sparse coordinate planes when the sparse histogram
+        tier is active (the grower unpacks the tuple)."""
+        sp = getattr(self, "_sparse_dev", None)
+        return self._bins_dev if sp is None else (self._bins_dev, sp)
+
     @property
     def _bins_train_dev(self) -> jax.Array:
         """The training columns of the grower bin matrix (valid-set
@@ -1052,6 +1130,10 @@ class GBDT:
             step_cache.aux_signature(aux_dev),
             step_cache.aux_signature(
                 dict(zip(type(meta_dev)._fields, meta_dev))),
+            # sparse histogram tier: the flag rides _grower_cfg above;
+            # the bucketed nnz plane length shapes the trace
+            ("sparse", None if getattr(self, "_sparse_dev", None) is None
+             else int(self._sparse_dev[0].shape[0])),
         )
 
     @staticmethod
@@ -1265,7 +1347,7 @@ class GBDT:
             t0 = _time.monotonic()
         with timing.phase("train/step_dispatch"):
             self._scores, new_valids, recs = step(
-                self._bins_dev,
+                self._step_bins(),
                 self._scores, tuple(self._valid_scores), mask, fmask,
                 jnp.float32(self.shrinkage_rate), init_bias, g_in, h_in,
                 key)
@@ -1650,6 +1732,12 @@ class GBDT:
         = 2|raw|, multiclass margin = top1 - top2). Rows stop in
         batches of ``freq`` — inherently data-dependent, so it runs on
         the host tree path."""
+        out = self._predict_sparse_chunked(
+            X, lambda Xd: self.predict_raw(
+                Xd, num_iteration, start_iteration, pred_early_stop,
+                pred_early_stop_freq, pred_early_stop_margin))
+        if out is not None:
+            return out
         X = np.asarray(X, np.float64)
         n = X.shape[0]
         k = self.num_tree_per_iteration
@@ -1715,6 +1803,25 @@ class GBDT:
             out /= used_iters
         return out[0] if k == 1 else out.T
 
+    @staticmethod
+    def _predict_sparse_chunked(X, fn):
+        """CSR predict input (io/sparse.py SparseMatrix) densifies in
+        bounded row chunks through ``fn`` — never the whole [N, F]
+        matrix; the chunk shrinks with the column count so even a
+        100k-column hashed matrix stays under the densify byte budget.
+        Bit-exact: every predict path is row-independent. Returns None
+        for non-sparse input (the caller proceeds dense)."""
+        from ..io.sparse import SparseMatrix, predict_chunk_rows
+        if not isinstance(X, SparseMatrix):
+            return None
+        n = X.shape[0]
+        chunk = predict_chunk_rows(X.shape[1])
+        if n <= chunk:
+            return fn(X.to_dense())
+        parts = [fn(X.to_dense_rows(r0, min(r0 + chunk, n)))
+                 for r0 in range(0, n, chunk)]
+        return np.concatenate(parts, axis=0)
+
     def _bin_input(self, X: np.ndarray) -> np.ndarray:
         """Bin raw rows with the train mappers -> [F, N] feature-major
         (bundle-encoded when the train set used EFB)."""
@@ -1746,6 +1853,10 @@ class GBDT:
 
     def predict_leaf_index(self, X: np.ndarray,
                            num_iteration: int = -1) -> np.ndarray:
+        out = self._predict_sparse_chunked(
+            X, lambda Xd: self.predict_leaf_index(Xd, num_iteration))
+        if out is not None:
+            return out
         self._ensure_host_trees()
         X = np.asarray(X, np.float64)
         ntree = self._effective_num_models()
@@ -1765,6 +1876,10 @@ class GBDT:
         """SHAP feature contributions [N, F+1] (or [N, K*(F+1)] for
         multiclass): per-feature Shapley values + bias column
         (gbdt.h PredictContrib / tree.h:118)."""
+        out = self._predict_sparse_chunked(
+            X, lambda Xd: self.predict_contrib(Xd, num_iteration))
+        if out is not None:
+            return out
         self._ensure_host_trees()
         X = np.asarray(X, np.float64)
         n = X.shape[0]

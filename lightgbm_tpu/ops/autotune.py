@@ -513,6 +513,49 @@ def tune_hist_chunk(*, fused: bool, F: int, B: int, W: int,
 
 
 # ---------------------------------------------------------------------------
+# Histogram-tier selection (dense one-hot pass vs sparse scatter)
+# ---------------------------------------------------------------------------
+
+# auto-tier density ceiling: the sparse scatter touches ~nnz * W slot
+# compares + 3 scatters per channel where the dense pass touches N * F
+# one-hot work regardless of density — below ~1/8 density the sparse
+# side wins with margin on every backend measured; the cost model is a
+# rule (not a timed sweep) because the tier also changes EXACTNESS
+# (see tune_hist_tier), so auto only engages where it is bit-equal
+SPARSE_TIER_MAX_DENSITY = 0.125
+
+
+def tune_hist_tier(*, requested: int, density: float, nnz: int,
+                   F: int, B: int, W: int, quant: bool) -> bool:
+    """True = the sparse histogram tier (ops/hist_wave.py
+    wave_histogram_sparse, scatter over nnz) serves this booster;
+    False = the dense one-hot tier. Selected per (density, geometry)
+    like the other kernel tiers — the caller (models/gbdt.py) has
+    already checked the structural gates (serial learner, no EFB
+    bundles, coordinates present).
+
+    ``requested`` is config.tpu_sparse (-1 auto / 0 off / 1 force).
+    The auto rule is exactness-first: integer (quantized) accumulation
+    is order-free, so the sparse completion subtraction is BIT-equal
+    to the dense tier — auto therefore requires ``quant`` AND density
+    under SPARSE_TIER_MAX_DENSITY. tpu_sparse=1 forces the tier for
+    f32 histograms too (final-ulp reassociation drift vs the dense
+    tier is possible; logged)."""
+    if requested == 0:
+        return False
+    if requested == 1:
+        if not quant:
+            log.info("tpu_sparse=1 with f32 histograms: the sparse "
+                     "tier's default-bin completion reassociates "
+                     "sums — final-ulp drift vs the dense tier is "
+                     "possible (tpu_quantized_hist makes it bit-exact)")
+        return True
+    if not quant:
+        return False
+    return float(density) <= SPARSE_TIER_MAX_DENSITY
+
+
+# ---------------------------------------------------------------------------
 # Histogram-psum wire-format tuning (data-parallel reduction)
 # ---------------------------------------------------------------------------
 

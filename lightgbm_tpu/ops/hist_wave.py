@@ -126,6 +126,93 @@ def wave_histogram_xla(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 
 
 # ---------------------------------------------------------------------------
+# Sparse histogram tier (CSR-native datasets, io/sparse.py)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_bins",
+                                             "num_features"))
+def wave_histogram_sparse(sp, g, h, leaf_ids, wave_leaves, *, num_bins,
+                          num_features, gh_scale=None):
+    """[W, F, B, 3] wave histograms by scatter over the nnz explicit
+    entries — the O(nnz) tier for CSR-native datasets.
+
+    ``sp`` = (codes, feat, row, zero_bins): per-entry bin code, INNER
+    feature index and global row of every explicit entry (device
+    planes from io/ingest.py SparseDeviceBinner or host coords from
+    io/dataset.py), plus the per-feature bin of the implicit value 0.0.
+    Sentinel (pad) entries carry ``feat >= F`` and are dropped.
+
+    Three scatter families per channel instead of the dense one-hot
+    pass over N x F:
+
+    - explicit entries add their row's (g, h, 1) at
+      ``slot*F*B + feat*B + code``  — O(nnz);
+    - per-(slot, feature) explicit subtotals at ``slot*F + feat`` and
+      per-slot row totals (O(nnz + N)) complete the DEFAULT bin:
+      ``hist[w, f, zero_bin_f] += leaf_total_w - explicit_subtotal_wf``
+      (the implicit cells of feature f in leaf w are exactly the
+      leaf's rows minus its explicit entries — the EFB module uses the
+      same most-frequent-bin complement, io/efb.py).
+
+    Exactness: with integer-valued g/h (tpu_quantized_hist) and counts,
+    every sum is exact, so the result is BIT-equal to the dense
+    ``wave_histogram_xla`` — order-free integers make the completion
+    subtraction exact. With raw f32 gradients the completion
+    reassociates the default-bin sum, so final-ulp drift vs the dense
+    tier is possible (the tpu_sparse=-1 auto rule therefore requires
+    quantized histograms; =1 forces the tier anyway).
+
+    ``gh_scale`` dequantizes quantized sums exactly like the dense XLA
+    path (same scalar multiply on equal integer sums -> bit-equal
+    f32)."""
+    codes, feat, row, zb = sp
+    F = num_features
+    B = num_bins
+    W = wave_leaves.shape[0]
+    size = W * F * B
+    f32 = jnp.float32
+    feat = feat.astype(jnp.int32)
+    codes = codes.astype(jnp.int32)
+    row = row.astype(jnp.int32)
+
+    # entry -> wave slot via its row's leaf (mirrors the dense oracle)
+    lr = leaf_ids[row]                                    # [E]
+    eq = (lr[None, :] == wave_leaves[:, None]) \
+        & (wave_leaves >= 0)[:, None]                     # [W, E]
+    found = eq.any(axis=0) & (feat < F)
+    slot = jnp.argmax(eq, axis=0).astype(jnp.int32)
+    flat = jnp.where(found, slot * (F * B) + feat * B + codes, size)
+    flatf = jnp.where(found, slot * F + feat, W * F)
+
+    # row -> wave slot for the per-leaf totals
+    eqr = (leaf_ids[None, :] == wave_leaves[:, None]) \
+        & (wave_leaves >= 0)[:, None]                     # [W, N]
+    slotr = jnp.where(eqr.any(axis=0),
+                      jnp.argmax(eqr, axis=0).astype(jnp.int32), W)
+
+    # default-bin completion targets: (w, f) -> flat bin index of f's
+    # zero bin in slot w
+    didx = (jnp.arange(W, dtype=jnp.int32)[:, None] * (F * B)
+            + jnp.arange(F, dtype=jnp.int32)[None, :] * B
+            + zb.astype(jnp.int32)[None, :]).reshape(-1)  # [W*F]
+
+    def chan(v):
+        ev = v[row].astype(f32)
+        he = jnp.zeros(size, f32).at[flat].add(ev, mode="drop")
+        sub = jnp.zeros(W * F, f32).at[flatf].add(ev, mode="drop")
+        ls = jnp.zeros(W + 1, f32).at[slotr].add(v.astype(f32))[:W]
+        return he.at[didx].add((ls[:, None] - sub.reshape(W, F))
+                               .reshape(-1))
+
+    hist = jnp.stack([chan(g), chan(h),
+                      chan(jnp.ones_like(g, f32))], axis=1)
+    hist = hist.reshape(W, F, B, 3)
+    if gh_scale is not None:
+        hist = hist * _qscale_vec(gh_scale)
+    return hist
+
+
+# ---------------------------------------------------------------------------
 # Pallas TPU kernel
 # ---------------------------------------------------------------------------
 

@@ -117,12 +117,18 @@ def bucket_rows(n: int, align: int = 1, policy: Optional[int] = None) -> int:
         return _round_up(n, align)
     if p > 0:
         return _round_up(_round_up(n, p), align)
-    b = max(n, MIN_BUCKET)
+    return _round_up(pow2_bucket(n, MIN_BUCKET), align)
+
+
+def pow2_bucket(x: int, floor: int) -> int:
+    """THE shared shape-taper every bucketing discipline uses (score
+    rows, sparse nnz planes, ingest entry planes): next power of two
+    >= max(x, floor) up to 16384; above that, pow2/16 steps (8 buckets
+    per octave) capping the pad at ~1/8."""
+    b = max(int(x), int(floor))
     if b <= (1 << 14):
-        b = 1 << (b - 1).bit_length()
-    else:
-        b = _round_up(b, 1 << ((b - 1).bit_length() - 4))
-    return _round_up(b, align)
+        return 1 << (b - 1).bit_length()
+    return _round_up(b, 1 << ((b - 1).bit_length() - 4))
 
 
 def _round_up(x: int, m: int) -> int:
@@ -148,6 +154,23 @@ def bucket_bins(b: int, policy: Optional[int] = None) -> int:
     if p == 0:
         return b
     return 1 << (max(b, 16) - 1).bit_length()
+
+
+def bucket_entries(e: int, policy: Optional[int] = None) -> int:
+    """Padded sparse-coordinate length (the geometry key's nnz bucket)
+    for ``e`` explicit entries: the sliding-window workload's windows
+    carry different nnz, and without bucketing every window's sparse
+    planes would be a fresh trace shape. Same policy shape as
+    ``bucket_rows``: -1 (auto) next power of two (floor 1024) with
+    pow2/16 steps above 16k; 0 exact; N > 0 multiples of N. Pad
+    entries carry an out-of-range feature index, which every scatter
+    in the sparse histogram drops (ops/hist_wave.py)."""
+    p = (_bucket if policy is None else int(policy))
+    if p == 0:
+        return max(int(e), 1)
+    if p > 0:
+        return _round_up(max(int(e), 1), p)
+    return pow2_bucket(e, 1024)
 
 
 def aux_signature(aux) -> tuple:

@@ -89,6 +89,16 @@ class WaveGrowerConfig(NamedTuple):
     # quantization scales are GLOBAL (max_reduce_fn = pmax), so the
     # scale factors commute with the cross-shard sum.
     quant_psum: bool = False
+    # sparse histogram tier (config.tpu_sparse, CSR-native datasets):
+    # grow() receives ``bins_t`` as a TUPLE (dense [F, N] bins,
+    # (codes, feat, row, zero_bins) coordinate planes) and wave
+    # histograms accumulate by scatter over the nnz explicit entries
+    # plus a default-bin completion (ops/hist_wave.py
+    # wave_histogram_sparse) instead of the dense one-hot pass; the
+    # dense matrix stays resident for the partition. Serial learner
+    # only; excludes the fused kernel, count-proxy, packed4 and
+    # injected seams.
+    sparse_hist: bool = False
 
 
 class _State(NamedTuple):
@@ -252,6 +262,13 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                          "histogram/partition seams")
     if cfg.packed4 and not proxy:
         raise ValueError("packed4 bins require count_proxy mode")
+    if cfg.sparse_hist and (proxy or cfg.packed4 or cfg.quant_psum):
+        raise ValueError("sparse_hist does not compose with "
+                         "count_proxy/packed4/quant_psum")
+    if cfg.sparse_hist and (hist_fn is not None
+                            or partition_fn is not None):
+        raise ValueError("sparse_hist does not compose with injected "
+                         "histogram/partition seams")
     if quant and hist_fn is not None:
         # an injected histogram seam must understand quantized g/h —
         # silently dropping gh_scale would produce garbage histograms
@@ -281,12 +298,24 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                      if cfg.precision == "highest" else FUSED_MAX_WAVE)
         bundled = jnp.ndim(meta_const.bundle) != 0
         use_fused = (default_seams and W <= fused_cap
-                     and not bundled and _pallas_on(cfg.use_pallas))
+                     and not bundled and not cfg.sparse_hist
+                     and _pallas_on(cfg.use_pallas))
     if use_fused:
         from ..utils.device import on_tpu
         fused_interpret = not on_tpu()
 
-    if hist_fn is None:
+    if hist_fn is None and cfg.sparse_hist:
+        # sparse tier: the histogram source is the (dense bins, sparse
+        # planes) tuple grow() unpacks — scatter over nnz instead of
+        # the dense pass (ops/hist_wave.py)
+        from .hist_wave import wave_histogram_sparse
+
+        def hist_fn(src, g, h, leaf_ids, wave_leaves, gh_scale=None):
+            bt, sp = src
+            return wave_histogram_sparse(
+                sp, g, h, leaf_ids, wave_leaves, num_bins=B,
+                num_features=bt.shape[0], gh_scale=gh_scale)
+    elif hist_fn is None:
         def hist_fn(bins_t, g, h, leaf_ids, wave_leaves, gh_scale=None):
             return wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
                                   num_bins=B, chunk=cfg.chunk,
@@ -369,6 +398,12 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         (out-of-bag included) for score updates.
         """
         meta = meta_const if meta is None else meta
+        _sparse_planes = None
+        if cfg.sparse_hist:
+            # (dense bins, sparse coordinate planes): the dense matrix
+            # serves the partition, the planes the histogram scatters;
+            # hist call sites pass the pair through ``hsrc``
+            bins_t, _sparse_planes = bins_t
         F, n = bins_t.shape
         f32 = jnp.float32
         if cfg.packed4:
@@ -380,6 +415,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 hi = jnp.right_shift(bins_t, jnp.uint8(4))
                 bins_t = jnp.stack([lo, hi], axis=1).reshape(
                     -1, bins_t.shape[1])[:F]
+        # histogram source — bound AFTER the packed4 unpack above may
+        # have reassigned bins_t
+        hsrc = ((bins_t, _sparse_planes) if cfg.sparse_hist
+                else bins_t)
         grad = grad.astype(f32) * sample_mask
         hess = hess.astype(f32) * sample_mask
         in_bag = sample_mask > 0
@@ -477,7 +516,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 num_features=F if cfg.packed4 else None,
                 dequant=not defer)
         else:
-            local_root = call_hist(bins_t, bag_mask_ids(leaf0),
+            local_root = call_hist(hsrc, bag_mask_ids(leaf0),
                                    root_wl)              # [W, F, B, 3]
         root_hist = dq(hist_reduce_fn(local_root))
         F_h = root_hist.shape[1]
@@ -637,7 +676,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                         new_ids, feat, tbin, dleft,
                                         active, meta, iscat, catw)
                 hist_small = dq(hist_reduce_fn(
-                    call_hist(bins_t, bag_mask_ids(leaf_ids),
+                    call_hist(hsrc, bag_mask_ids(leaf_ids),
                               small_ids)))
                 if proxy:
                     # exact in-bag right-child counts (XLA fallback for
@@ -777,7 +816,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             # left child keeps the parent id: histogram it directly,
             # sibling by subtraction (sizes don't matter here)
             hist_left = dq(hist_reduce_fn(
-                call_hist(bins_t, bag_mask_ids(leaf_ids), wl)))
+                call_hist(hsrc, bag_mask_ids(leaf_ids), wl)))
             parent_hist = state.hist[wl]
             hist_right = parent_hist - hist_left
             wl_s = jnp.where(active, wl, L)

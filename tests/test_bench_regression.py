@@ -214,6 +214,56 @@ def test_check_schema_lrb_stream():
                for p in cbr.check_schema(_fresh(lrb_stream="n/a")))
 
 
+def _sparse_block(**kw):
+    d = {"rows": 200_000, "features": 256, "density": 0.0098,
+         "nnz": 501_760, "iters": 30,
+         "routes": {
+             "dense": {"route": "dense", "ingest_s": 3.2,
+                       "train_s": 41.0, "rows_per_s": 146341.0,
+                       "peak_rss_mb": 1410.2,
+                       "sparse_hist_tier": False,
+                       "model_sha1": "aa"},
+             "csr": {"route": "csr", "ingest_s": 0.8, "train_s": 39.5,
+                     "rows_per_s": 151898.0, "peak_rss_mb": 620.4,
+                     "sparse_hist_tier": True, "model_sha1": "aa"}},
+         "peak_rss_ratio": 2.273, "model_parity": True}
+    d.update(kw)
+    return d
+
+
+def test_check_schema_sparse():
+    # the standalone --sparse line: unit rows/s + sparse block
+    standalone = {"metric": "sparse CTR GBDT training (200000 rows x "
+                            "256 feat, density 0.0098, 30 iters)",
+                  "value": 151898.0, "unit": "rows/s",
+                  "sparse": _sparse_block()}
+    assert cbr.check_schema(standalone) == []
+    # rows/s without the block is a shape problem
+    assert any("sparse" in p for p in cbr.check_schema(
+        {"metric": "m", "value": 1.0, "unit": "rows/s"}))
+    # missing route metrics are named per route
+    broken = _sparse_block()
+    del broken["routes"]["csr"]["peak_rss_mb"]
+    assert any("routes.csr.peak_rss_mb" in p for p in cbr.check_schema(
+        dict(standalone, sparse=broken)))
+    no_dense = _sparse_block()
+    del no_dense["routes"]["dense"]
+    assert any("routes.dense" in p for p in cbr.check_schema(
+        dict(standalone, sparse=no_dense)))
+    # diverged models across routes fail the artifact outright
+    assert any("model_parity" in p for p in cbr.check_schema(
+        dict(standalone, sparse=_sparse_block(model_parity=False))))
+    # wrong container types are reported, not crashed on
+    assert any("not a dict" in p for p in cbr.check_schema(
+        dict(standalone, sparse="n/a")))
+    assert any("sparse.routes" in p for p in cbr.check_schema(
+        dict(standalone, sparse=_sparse_block(routes=7))))
+    # cross-workload refusal still wins: a sparse line never compares
+    # against a HIGGS training baseline
+    assert cbr.compare(standalone, _fresh())[0].startswith(
+        "not comparable")
+
+
 def test_compare_lrb_stream_gate():
     base = _fresh(lrb_stream=_stream(requests_per_s=200.0,
                                      staleness=0.0))

@@ -85,20 +85,27 @@ def trajectory(baseline_dir: str) -> List[str]:
 
 def check_schema(fresh: dict) -> List[str]:
     """Shape problems in a (normalized) fresh bench artifact — the
-    HIGGS-class training line (unit ``M row-iters/s``) or the
-    standalone ``bench.py --lrb-stream`` line (unit ``requests/s``,
-    details under ``lrb_stream``); a training line may also CARRY an
-    ``lrb_stream`` section (the appended compact stream bench)."""
+    HIGGS-class training line (unit ``M row-iters/s``), the standalone
+    ``bench.py --lrb-stream`` line (unit ``requests/s``, details under
+    ``lrb_stream``) or the ``bench.py --sparse`` line (unit ``rows/s``,
+    dense-vs-CSR routes under ``sparse``); a training line may also
+    CARRY an ``lrb_stream`` section (the appended compact stream
+    bench)."""
     problems = []
     stream_only = fresh.get("unit") == "requests/s"
+    sparse_only = fresh.get("unit") == "rows/s"
     if not isinstance(fresh.get("value"), (int, float)):
         problems.append("missing numeric 'value' "
                         + ("(requests/s)" if stream_only
+                           else "(rows/s)" if sparse_only
                            else "(M row-iters/s)"))
     if stream_only:
         if not isinstance(fresh.get("lrb_stream"), dict):
             problems.append("unit requests/s but no 'lrb_stream' "
                             "object")
+    elif sparse_only:
+        if not isinstance(fresh.get("sparse"), dict):
+            problems.append("unit rows/s but no 'sparse' object")
     elif fresh.get("unit") != "M row-iters/s":
         problems.append(f"unexpected unit {fresh.get('unit')!r}")
     if not isinstance(fresh.get("metric"), str):
@@ -120,6 +127,36 @@ def check_schema(fresh: dict) -> List[str]:
                 problems.append(
                     "lrb_stream.serve_p99_during_retrain_ms is "
                     f"{type(p99d).__name__}, not numeric/null")
+    sp = fresh.get("sparse")
+    if sp is not None:
+        if not isinstance(sp, dict):
+            problems.append(
+                f"sparse is {type(sp).__name__}, not a dict")
+        else:
+            routes = sp.get("routes")
+            if not isinstance(routes, dict):
+                problems.append("sparse.routes missing/not a dict")
+            else:
+                for rname in ("dense", "csr"):
+                    r = routes.get(rname)
+                    if not isinstance(r, dict):
+                        problems.append(
+                            f"sparse.routes.{rname} missing/not a dict")
+                        continue
+                    for k in ("rows_per_s", "peak_rss_mb"):
+                        if not isinstance(r.get(k), (int, float)):
+                            problems.append(
+                                f"sparse.routes.{rname}.{k} "
+                                "missing/null")
+            for k in ("density", "nnz"):
+                if not isinstance(sp.get(k), (int, float)):
+                    problems.append(f"sparse.{k} missing/null")
+            # a silently-diverged model across routes is a correctness
+            # bug, not a perf number — fail the artifact's shape check
+            if sp.get("model_parity") is False:
+                problems.append("sparse.model_parity is false: the "
+                                "dense and CSR routes trained "
+                                "different models")
     lat = fresh.get("predict_latency")
     if lat is not None:
         if not isinstance(lat, dict):
