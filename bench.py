@@ -360,6 +360,49 @@ def sparse_bench(args) -> dict:
     return out
 
 
+# default SLO specs per bench mode (obs/slo.py grammar): generous
+# ceilings — the section exists to put budget/burn/p99.9 numbers in
+# the artifact (gated for SHAPE by tools/check_bench_regression.py),
+# not to fail a shared-host run on scheduling noise. --slo overrides.
+DEFAULT_SLO_TRAIN = "predict_p99_ms<5000;degraded_window_rate<0.5"
+DEFAULT_SLO_STREAM = ("serve_p99_ms<5000;staleness_windows<=8;"
+                      "degraded_window_rate<0.5")
+
+
+def slo_section(spec: str) -> dict:
+    """Evaluate ``spec`` against the run's live registry state and
+    return the bench JSON's ``slo`` section: overall compliance,
+    remaining error budget, burn rate, the p99.9 serving tails
+    (obs/registry.py quantiles now reach past p99), and one compact
+    row per objective. Installed as the process-global engine so a
+    live exporter's /slo endpoint reports the same budgets."""
+    from lightgbm_tpu.obs import registry as obs_registry
+    from lightgbm_tpu.obs import slo as obs_slo
+    # one idempotence rule: a running engine with the same spec text
+    # keeps its burn/latch state, anything else is replaced
+    # (obs/slo.py ensure_from_config)
+    eng = obs_slo.ensure_from_config({"tpu_slo": spec})
+    rep = eng.report(fresh=True)
+
+    def p999_ms(name):
+        v = obs_registry.latency_histogram(name).percentile(0.999)
+        return None if v is None else round(1e3 * v, 3)
+
+    return {
+        "spec": spec,
+        "ok": rep.get("ok"),
+        "violating": rep.get("violating", 0),
+        "budget_remaining_min": rep.get("budget_remaining_min"),
+        "burn_rate_max": rep.get("burn_rate_max"),
+        "predict_p999_ms": p999_ms("predict/latency_s"),
+        "serve_p999_ms": p999_ms("lrb/serve_latency_s"),
+        "objectives": [
+            {k: r[k] for k in ("name", "ok", "current", "threshold",
+                               "budget_remaining", "burn_rate")}
+            for r in rep.get("specs", [])],
+    }
+
+
 def _auc(y, s):
     """Holdout AUC through the engine's own metric implementation."""
     from lightgbm_tpu.config import Config
@@ -425,6 +468,11 @@ def main():
     ap.add_argument("--lrb-sample", type=int, default=512)
     ap.add_argument("--lrb-iters", type=int, default=10)
     ap.add_argument("--lrb-serve-batch", type=int, default=32)
+    ap.add_argument("--slo", default="",
+                    help="SLO spec string (obs/slo.py grammar) for the "
+                         "JSON line's 'slo' section — budget remaining, "
+                         "burn rate, p99.9 tails; default: a generous "
+                         "built-in set per bench mode")
     ap.add_argument("--lrb-rate", type=float, default=-1.0,
                     help="offered request rate (requests/s) for the "
                          "lrb-stream feeder; -1 = auto-calibrate so "
@@ -449,6 +497,15 @@ def main():
                          "CTR workload (default ~1%%)")
     ap.add_argument("--sparse-iters", type=int, default=30)
     args = ap.parse_args()
+    if args.slo:
+        # refuse a malformed spec NOW, not after an hours-long run
+        # when slo_section() would crash before the JSON line is
+        # emitted (the config.py tpu_slo validation rule)
+        from lightgbm_tpu.obs.slo import parse_specs
+        try:
+            parse_specs(args.slo)
+        except ValueError as e:
+            ap.error(str(e))
     if args.quick:
         args.rows, args.iters, args.leaves = 65_536, 20, 63
 
@@ -476,6 +533,7 @@ def main():
         stream = lrb_stream_bench(args)
         print(json.dumps({
             "lrb_stream": stream,
+            "slo": slo_section(args.slo or DEFAULT_SLO_STREAM),
             "metric": ("LRB streaming retrain-while-serve "
                        f"({stream['windows']} windows x "
                        f"{stream['window_rows']} rows, sample "
@@ -692,6 +750,7 @@ def main():
     # server would call — micro-batches pad to pow2 serve buckets and
     # dispatch through the geometry-keyed predict registry, so every
     # batch size 1..bucket rides one warm compiled program.
+    from lightgbm_tpu.obs import reqlog as obs_reqlog
     from lightgbm_tpu.ops import predict_cache
     serve = None
     if args.serve:
@@ -708,9 +767,21 @@ def main():
             t_end = t0 + args.serve_seconds
             while time.time() < t_end:
                 r0 = (reqs * b) % max(n_test - b, 1)
+                # request-scoped (obs/reqlog.py): each serve request
+                # gets a monotonic id carried through the predict
+                # stack (spans tagged, serve bucket noted) and ONE
+                # wide event — the same identity a model server's
+                # stream would carry
+                rid = obs_reqlog.next_request_id()
                 tb = time.time()
-                g.predict_raw(X_test[r0:r0 + b])
-                hist.observe(time.time() - tb)
+                with obs_reqlog.request(rid) as rctx:
+                    g.predict_raw(X_test[r0:r0 + b])
+                dt = time.time() - tb
+                hist.observe(dt)
+                obs_reqlog.record(
+                    "request", req_id=rid, path="bench/serve", rows=b,
+                    latency_ms=round(1e3 * dt, 3),
+                    serve_bucket=rctx.bucket)
                 reqs += 1
                 rows += b
             wall = time.time() - t0
@@ -741,6 +812,12 @@ def main():
         stream = lrb_stream_bench(args)
         recorder.meta["lrb_stream"] = stream
 
+    # SLO/error-budget section: evaluated over the run's own predict/
+    # serve histograms (p99.9 now rides the quantile readout); the
+    # regression tool validates the section's shape
+    slo = slo_section(args.slo or DEFAULT_SLO_TRAIN)
+    recorder.meta["slo"] = slo
+
     recorder.meta["step_cache"] = step_cache.stats()
     recorder.meta["predict_cache"] = predict_cache.stats()
     report = recorder.finish(
@@ -765,6 +842,7 @@ def main():
         "serve": serve,
         "retrain": retrain,
         "lrb_stream": stream,
+        "slo": slo,
         "train_auc": round(float(auc), 5),
         "test_auc": round(float(test_auc), 5),
         # quantiles from the log-bucketed histogram, not a sample list:

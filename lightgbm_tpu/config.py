@@ -440,10 +440,40 @@ class Config:
     tpu_metrics_interval_s: float = 5.0
     # serve live metrics over HTTP (obs/export.py): a stdlib
     # http.server on 127.0.0.1:<port> answering GET /metrics
-    # (Prometheus text) and /metrics.json (raw snapshot) while the
-    # process runs — point a scraper at a live training/serving loop.
+    # (Prometheus text), /metrics.json (raw snapshot), /healthz
+    # (liveness + last-snapshot age + SLO budget state, JSON) and /slo
+    # (the full error-budget report) while the process runs — point a
+    # scraper or a fleet health check at a live training/serving loop.
     # 0 = off.
     tpu_metrics_port: int = 0
+    # request-scoped wide-event log (obs/reqlog.py): JSONL path for
+    # one structured record per serving request batch and per lrb
+    # window — request id, latency, rows, serve bucket, the serving
+    # model's window, degraded/staleness state. The in-memory ring
+    # feeding the flight recorder is always on; this knob adds the
+    # on-disk file. Empty = no file.
+    tpu_reqlog: str = ""
+    # fraction of request records written to the tpu_reqlog file,
+    # decided DETERMINISTICALLY per request id (lowbias32 hash — the
+    # same ids are sampled at the same rate on every run). Window and
+    # degraded-window records are never sampled out. Clamped to [0, 1].
+    tpu_reqlog_sample: float = 1.0
+    # SLO specs (obs/slo.py), ";"-separated, evaluated every exporter
+    # interval: e.g. "predict_p99_ms<50;staleness_windows<=2;
+    # degraded_window_rate<0.05" (also hist:/gauge:/ratio: forms).
+    # Compliance, remaining error budget and burn rate become slo/*
+    # gauges, the /healthz + /slo endpoint bodies, and — on budget
+    # exhaustion — a flight-recorder trigger. Empty = no SLOs.
+    tpu_slo: str = ""
+    # flight recorder ring capacity (obs/flight.py): recent spans, log
+    # lines and reqlog records kept in memory and dumped as ONE
+    # postmortem JSON bundle when the watchdog fires, a fault
+    # injects, an lrb window degrades, an SLO budget exhausts, or the
+    # process gets SIGTERM/an uncaught exception. Bundles land next to
+    # the run's artifacts (run report/reqlog/export/trace path), else
+    # the system temp dir; run reports cross-link them as
+    # meta.flight_dumps. 0 disables the black box.
+    tpu_flight_buffer: int = 256
     # resumable checkpoints (utils/checkpoint.py): directory for
     # versioned JSON checkpoint bundles — the model text PLUS the
     # training state the model text lacks (iteration, bagging/feature/
@@ -742,6 +772,25 @@ class Config:
             log.warning("tpu_metrics_port=%d is not a port; disabling "
                         "the metrics endpoint (0)", self.tpu_metrics_port)
             self.tpu_metrics_port = 0
+        if not 0.0 <= self.tpu_reqlog_sample <= 1.0:
+            log.warning("tpu_reqlog_sample=%g is outside [0, 1]; "
+                        "clamping", self.tpu_reqlog_sample)
+            self.tpu_reqlog_sample = min(
+                max(self.tpu_reqlog_sample, 0.0), 1.0)
+        if self.tpu_flight_buffer < 0:
+            log.warning("tpu_flight_buffer=%d is negative; disabling "
+                        "the flight recorder (0)", self.tpu_flight_buffer)
+            self.tpu_flight_buffer = 0
+        if self.tpu_slo:
+            # refuse a malformed spec at config time, not in the
+            # exporter thread mid-run (the parse error names the
+            # offending fragment)
+            from .obs.slo import parse_specs
+            try:
+                parse_specs(self.tpu_slo)
+            except ValueError as e:
+                log.warning("tpu_slo disabled: %s", e)
+                self.tpu_slo = ""
         if self.is_provide_training_metric or self.valid:
             if not self.metric:
                 # force defaults from objective later; handled by metric factory

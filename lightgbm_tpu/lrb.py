@@ -50,7 +50,10 @@ import numpy as np
 
 from . import capi
 from .obs import export as obs_export
+from .obs import flight as obs_flight
 from .obs import registry as obs
+from .obs import reqlog
+from .obs import slo as obs_slo
 from .obs import trace
 from .utils import faults, log, retry
 
@@ -83,6 +86,24 @@ class WindowBudgetExceeded(RuntimeError):
     (serving continues on the previous model), and retry classifies
     it non-transient (re-running the same window would blow the same
     budget)."""
+
+
+def _degrade_label(reason: Optional[str]) -> str:
+    """Classify a degrade reason string into a small stable label set
+    — the ``lrb/degraded_reason/<label>`` counter family (Prometheus
+    needs bounded cardinality, the flight recorder needs *why*, not
+    just *that*). The raw reason string still rides the result record
+    and the wide event."""
+    if not reason or reason == "degenerate_labels":
+        return "degenerate_labels"
+    head = reason.split(":", 1)[0].strip()
+    if head == "WindowBudgetExceeded":
+        return "budget"
+    if head == "InjectedFault":
+        return ("injected_fault_transient" if "action=transient" in reason
+                else "injected_fault")
+    import re as _re
+    return _re.sub(r"[^A-Za-z0-9_]", "_", head) or "error"
 
 
 class Window:
@@ -126,6 +147,14 @@ class LrbDriver:
                             (extra_params or {}).items()})
         trace.ensure_from_config(self.params)
         obs_export.ensure_from_config(self.params)
+        # serving observability (the PR-12 layer): request-scoped wide
+        # events, the SLO/error-budget engine the exporter evaluates,
+        # and the always-on flight recorder — armed HERE so window 1's
+        # requests already carry ids and a window-1 failure already
+        # dumps a postmortem bundle
+        reqlog.ensure_from_config(self.params)
+        obs_slo.ensure_from_config(self.params)
+        obs_flight.ensure_from_config(self.params)
         # fault-injection drills armed HERE so pre-booster points
         # (dataset ingest) are covered from window 1 (idempotent:
         # every window's booster init re-arms the same spec)
@@ -196,6 +225,12 @@ class LrbDriver:
         self.window_index = 0
         self._results: List[dict] = []
         self.trace_lines_skipped = 0
+        # flight-recorder bundles are process-global; remember where
+        # the dump list stood at init so ``flight_dumps`` reports only
+        # THIS run's bundles (whether the fault trigger or the
+        # degraded-window trigger produced them — the rate limiter
+        # coalesces one incident into one bundle)
+        self._flight_dumps_at_init = len(obs_flight.dump_paths())
 
     def _make_ring(self):
         """Device-resident ingest chunk ring (io/ingest.py ChunkRing)
@@ -246,13 +281,37 @@ class LrbDriver:
         trainer thread may be mid-window. Thread-safe: the handle is
         snapshotted under the swap lock and a concurrent publish never
         mutates an already-published booster (every window trains a
-        fresh one). None before the first successful window."""
+        fresh one). None before the first successful window.
+
+        Request-scoped (obs/reqlog.py): every call is issued a
+        monotonic request id, carried through the predict stack in the
+        thread-local context (trace spans and the serve-bucket seam
+        tag themselves with it), and closed with ONE wide event."""
         with self._swap_lock:
             h = self._serving
         if h is None:
             return None
-        return np.asarray(capi.LGBM_BoosterPredictForMat(
-            h, X, predict_type=capi.C_API_PREDICT_NORMAL))
+        rid = reqlog.next_request_id()
+        t0 = time.monotonic()
+        with reqlog.request(rid, window=self.window_index) as rctx, \
+                trace.span("serve/request", cat="serve",
+                           args={"req_id": rid,
+                                 "window": self.window_index}):
+            out = np.asarray(capi.LGBM_BoosterPredictForMat(
+                h, X, predict_type=capi.C_API_PREDICT_NORMAL))
+        reqlog.record(
+            "request", req_id=rid, path="lrb/live",
+            window=self.window_index, rows=int(len(X)),
+            latency_ms=round(1e3 * (time.monotonic() - t0), 3),
+            # the handle's OWN stamp (_train_model): a mid-window
+            # publish serves the new model before _trained_window
+            # advances at the boundary join — attribution follows the
+            # handle actually scored against
+            model_window=getattr(h, "_lrb_window",
+                                 self._trained_window),
+            serve_bucket=rctx.bucket,
+            staleness_windows=self._windows_since_train)
+        return out
 
     def training_in_flight(self) -> bool:
         """True while the trainer thread holds a window (the
@@ -306,7 +365,8 @@ class LrbDriver:
                 t0 = time.monotonic()
                 with trace.span("lrb/evaluate", cat="window", args=wi):
                     labels, X = self._derive_features(0)
-                    rec.update(self._score_window(labels, X))
+                    rec.update(self._score_window(
+                        labels, X, window=self.window_index))
                 rec["evaluate_s"] = round(time.monotonic() - t0, 3)
             t0 = time.monotonic()
             with trace.span("lrb/derive", cat="window", args=wi):
@@ -638,6 +698,13 @@ class LrbDriver:
         gauge, degrade counters, result fields) — always on the main
         thread, at the point the outcome becomes part of the window's
         record."""
+        # denominator of the degraded_window_rate SLO, counted BEFORE
+        # the degraded counter below: with den leading num at the
+        # producer and the engine reading num before den (obs/slo.py),
+        # a concurrent ratio evaluation can never observe the new
+        # degraded window without its denominator — which would
+        # overshoot the rate and falsely latch budget exhaustion
+        obs.counter("lrb/windows_total").add(1)
         if stats is not None:
             self._windows_since_train = 0
             self._trained_window = rec["window"]
@@ -648,6 +715,22 @@ class LrbDriver:
             obs.counter("lrb/windows_degraded").add(1)
             rec["degraded"] = True
             rec["degrade_reason"] = reason or "degenerate_labels"
+            # WHY, not just THAT: the labeled counter family gives
+            # Prometheus a rate per cause, the wide event gives the
+            # flight recorder the full reason string, and the flight
+            # dump captures the failing window's spans/requests NOW
+            label = _degrade_label(reason)
+            rec["degrade_label"] = label
+            obs.counter(f"lrb/degraded_reason/{label}").add(1)
+            reqlog.record(
+                "degraded_window", window=rec["window"], label=label,
+                reason=rec["degrade_reason"],
+                staleness_windows=self._windows_since_train)
+            obs_flight.trigger(
+                "degraded_window",
+                {"window": rec["window"], "label": label,
+                 "reason": rec["degrade_reason"],
+                 "staleness_windows": self._windows_since_train})
         obs.gauge("lrb/model_staleness_windows").set(
             self._windows_since_train)
         rec["staleness_windows"] = self._windows_since_train
@@ -681,7 +764,8 @@ class LrbDriver:
         def eval_job():
             t0 = time.monotonic()
             with trace.span("lrb/evaluate", cat="window", args=wi):
-                out = self._score_window(labels, X, handle=handle)
+                out = self._score_window(labels, X, handle=handle,
+                                         window=wi.get("window"))
             out["evaluate_s"] = round(
                 time.monotonic() - t0 + ev_derive_s, 3)
             return out, time.monotonic()
@@ -784,15 +868,29 @@ class LrbDriver:
                 ex.shutdown(wait=True)
                 setattr(self, attr, None)
 
+    # result-record fields replicated onto the per-window wide event
+    # (the flight recorder and the reqlog file both see the window's
+    # outcome without parsing the result line)
+    _WINDOW_EVENT_FIELDS = (
+        "eval_rows", "fp_rate", "fn_rate", "train_rows", "train_s",
+        "compile_s", "degraded", "degrade_reason", "degrade_label",
+        "staleness_windows", "serve_p99_ms", "window_wall_s",
+        "overlap_s")
+
     def _finish_window(self, rec: dict, wall: float) -> None:
         """A window's record is complete (sequential: at the boundary;
         pipelined: when its training resolves): quantile-grade wall
-        bookkeeping, the result line, and a trace/result flush so a
-        live loop can be inspected mid-run and a killed run keeps its
-        last finished window."""
+        bookkeeping, the result line, one wide event, and a
+        trace/result flush so a live loop can be inspected mid-run and
+        a killed run keeps its last finished window."""
         rec["window_wall_s"] = round(wall, 3)
         self._wall_hist.observe(wall)
         obs.latency_histogram("lrb/window_wall_s").observe(wall)
+        # (lrb/windows_total is counted in _apply_train_outcome, den
+        # before num — see the ratio-race note there)
+        reqlog.record("window", window=rec["window"],
+                      **{k: rec[k] for k in self._WINDOW_EVENT_FIELDS
+                         if k in rec})
         print(f"window {rec['window']}: "
               + " ".join(f"{k}={v}" for k, v in rec.items()),
               file=self.out)
@@ -804,6 +902,13 @@ class LrbDriver:
         """Windows that did not produce a fresh model (failed training,
         blown budget, degenerate labels)."""
         return sum(1 for r in self.results if r.get("degraded"))
+
+    @property
+    def flight_dumps(self) -> List[str]:
+        """Flight-recorder bundles dumped since this driver started —
+        the postmortem evidence for this run's faults/degraded
+        windows, printed by main() next to the result summary."""
+        return obs_flight.dump_paths()[self._flight_dumps_at_init:]
 
     def _train_model(self, labels: np.ndarray, X: np.ndarray,
                      widx: int,
@@ -848,6 +953,12 @@ class LrbDriver:
                  "%.2fs, step cache +%d hit / +%d miss)",
                  widx, len(labels), train_s, compile_s,
                  s1["hits"] - s0["hits"], s1["misses"] - s0["misses"])
+        # stamp the model's generation ON the handle: predict_live
+        # reads the LIVE published handle, which in pipelined mode
+        # can be newer than _trained_window (that field only advances
+        # at the next boundary join) — the wide event's model
+        # attribution must follow the handle, not the lagging field
+        booster._lrb_window = widx
         return ({"train_s": round(train_s, 3),
                  "compile_s": round(compile_s, 3),
                  "step_cache_hits": s1["hits"] - s0["hits"]},
@@ -876,7 +987,7 @@ class LrbDriver:
                 if v is not None}
 
     def _score_window(self, labels: np.ndarray, X: np.ndarray,
-                      handle=None) -> dict:
+                      handle=None, window: Optional[int] = None) -> dict:
         # the serving half of the loop: this window's requests scored
         # against the previous window's model in micro-batches through
         # the geometry-keyed predict path (pow2 serve buckets,
@@ -886,7 +997,11 @@ class LrbDriver:
         # observations (each request in it waited the batch out), so
         # the p99 an operator reads is a REQUEST quantile. ``handle``
         # pins the model (the pipelined boundary's join-time snapshot);
-        # None = the currently published one.
+        # None = the currently published one. ``window`` stamps the
+        # request identity: every micro-batch is issued a monotonic
+        # request id, its trace span carries req_id/window, and one
+        # wide event per batch records latency / serve bucket / model
+        # generation / staleness (obs/reqlog.py).
         if handle is not None:
             h = handle
         else:
@@ -897,17 +1012,39 @@ class LrbDriver:
         parts = []
         global_hist = obs.latency_histogram("lrb/serve_latency_s")
         global_batch = obs.latency_histogram("lrb/serve_batch_s")
+        # model attribution for the wide events: prefer the pinned
+        # handle's own generation stamp (_train_model). The fallback
+        # fields are safe here too — they are updated ONLY by
+        # _apply_train_outcome on the main thread, and the pipelined
+        # boundary join resolves this evaluation's future BEFORE
+        # applying the next outcome (_join_pending_locked), so they
+        # describe the pinned ``handle`` even while the trainer
+        # thread publishes mid-evaluation
+        model_window = getattr(h, "_lrb_window", self._trained_window)
+        staleness = self._windows_since_train
         for r0 in range(0, n, b):
             rows = min(b, n - r0)
+            rid = reqlog.next_request_id()
+            span_args = {"req_id": rid, "rows": rows}
+            if window is not None:
+                span_args["window"] = window
             t0 = time.monotonic()
-            parts.append(np.asarray(capi.LGBM_BoosterPredictForMat(
-                h, X[r0:r0 + b],
-                predict_type=capi.C_API_PREDICT_NORMAL)))
+            with reqlog.request(rid, window=window) as rctx, \
+                    trace.span("serve/request", cat="serve",
+                               args=span_args):
+                parts.append(np.asarray(capi.LGBM_BoosterPredictForMat(
+                    h, X[r0:r0 + b],
+                    predict_type=capi.C_API_PREDICT_NORMAL)))
             dt = time.monotonic() - t0
             self._serve_batch_hist.observe(dt)
             global_batch.observe(dt)
             self._serve_hist.observe_n(dt, rows)
             global_hist.observe_n(dt, rows)
+            reqlog.record(
+                "request", req_id=rid, path="lrb/serve", window=window,
+                rows=rows, latency_ms=round(1e3 * dt, 3),
+                model_window=model_window, serve_bucket=rctx.bucket,
+                staleness_windows=staleness)
         preds = (np.concatenate(parts) if parts
                  else np.zeros(0, np.float64))
         fp = ((labels < self.cutoff) & (preds >= self.cutoff)).sum()
@@ -1008,6 +1145,11 @@ def _run_main(argv, out) -> None:
     if dw:
         print(f"degraded_windows={dw} "
               f"model_staleness_windows={driver._windows_since_train}",
+              file=out)
+    if driver.flight_dumps:
+        # the black box's postmortem bundles, findable from the result
+        # file (tools/trace_summary.py renders them)
+        print("flight_dumps " + " ".join(driver.flight_dumps),
               file=out)
 
 

@@ -27,6 +27,7 @@ hides it with async copies; we remove the transfers instead).
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 from typing import Dict, List, Optional, Sequence
@@ -38,6 +39,8 @@ import numpy as np
 from ..config import Config
 from ..io.dataset import TpuDataset
 from ..metrics import Metric
+from ..obs import reqlog as obs_reqlog
+from ..obs import trace as obs_trace
 from ..objectives import ObjectiveFunction
 from ..ops.grower import pack_record, unpack_record
 from ..ops.predict import add_leaf_outputs, replay_partition
@@ -125,9 +128,17 @@ class GBDT:
         # booster with the knobs set starts them, every later one
         # (each sliding window's fresh booster) joins
         from ..obs import export as obs_export
-        from ..obs import trace as obs_trace
+        from ..obs import flight as obs_flight
+        from ..obs import slo as obs_slo
         obs_trace.ensure_from_config(config)
         obs_export.ensure_from_config(config)
+        # serving observability (obs/): the request-scoped wide-event
+        # log, the SLO/error-budget engine the exporter thread
+        # evaluates, and the always-on flight recorder — same
+        # first-starts, later-joins discipline as the daemons above
+        obs_reqlog.ensure_from_config(config)
+        obs_slo.ensure_from_config(config)
+        obs_flight.ensure_from_config(config)
         # deterministic fault injection (utils/faults.py): the
         # tpu_faults knob arms the recovery drills' injection points
         from ..utils import faults
@@ -1789,8 +1800,22 @@ class GBDT:
               else None)
         if sm is not None:
             # whole-ensemble MXU scan: one dispatch chain instead of one
-            # replay per tree (ops/stacked_predict.py)
-            out = sm.predict(X, first, ntree).astype(np.float64)
+            # replay per tree (ops/stacked_predict.py). A serving-path
+            # caller with an active request context (obs/reqlog.py —
+            # the lrb loop, bench --serve) gets its dispatch spanned
+            # with the request identity, so the trace timeline answers
+            # "which request was on the device" during a stall.
+            rctx = obs_reqlog.current()
+            if rctx is not None:
+                args = {"req_id": rctx.req_id, "rows": int(n)}
+                if rctx.window is not None:
+                    args["window"] = rctx.window
+                span = obs_trace.span("predict/stacked", cat="serve",
+                                      args=args)
+            else:
+                span = contextlib.nullcontext()
+            with span:
+                out = sm.predict(X, first, ntree).astype(np.float64)
         else:
             self._ensure_host_trees()
             out = np.zeros((k, n), np.float64)

@@ -18,19 +18,36 @@
 - ``obs.export`` — live metrics exporter (``tpu_metrics_export``/
   ``tpu_metrics_interval_s``/``tpu_metrics_port``): a daemon that
   snapshots the default registry to Prometheus text + JSONL on an
-  interval and optionally serves ``/metrics`` over HTTP during a run.
+  interval and serves ``/metrics`` + the operational ``/healthz`` and
+  ``/slo`` endpoints over HTTP during a run.
+- ``obs.reqlog`` — request-scoped wide events (``tpu_reqlog``/
+  ``tpu_reqlog_sample``): monotonically-issued request ids carried
+  through the predict stack in a thread-local context, one structured
+  JSONL record per request batch and per lrb window, deterministic
+  per-id file sampling, and an always-on ring the flight recorder
+  dumps.
+- ``obs.slo`` — SLO / error-budget engine (``tpu_slo``): declarative
+  objective specs evaluated by the exporter thread every interval;
+  compliance, remaining error budget and burn rate become first-class
+  gauges and the ``/healthz``/``/slo`` bodies.
+- ``obs.flight`` — flight recorder (``tpu_flight_buffer``): always-on
+  bounded rings of recent spans, log lines, reqlog records and metric
+  snapshots, dumped as ONE self-contained postmortem bundle on
+  watchdog firings, faults, degraded lrb windows, SLO budget
+  exhaustion, SIGTERM and uncaught exceptions; run reports cross-link
+  the dumps as ``meta.flight_dumps``.
 
-Only the stdlib-dependency modules (registry, trace, export) are
-imported eagerly (utils/timing.py depends on registry and trace at
-module load); recorder/profiler import jax-adjacent modules and load on
-first use.
+Only the stdlib-dependency modules (registry, trace, export, reqlog,
+slo, flight) are imported eagerly (utils/timing.py depends on registry
+and trace at module load); recorder/profiler import jax-adjacent
+modules and load on first use.
 """
-from . import export, registry, trace
+from . import export, flight, registry, reqlog, slo, trace
 from .registry import (MetricsRegistry, counter, default_registry, gauge,
                        histogram, latency_histogram, timer)
 
 __all__ = [
-    "registry", "trace", "export", "MetricsRegistry",
-    "default_registry", "counter", "gauge", "histogram",
-    "latency_histogram", "timer",
+    "registry", "trace", "export", "reqlog", "slo", "flight",
+    "MetricsRegistry", "default_registry", "counter", "gauge",
+    "histogram", "latency_histogram", "timer",
 ]

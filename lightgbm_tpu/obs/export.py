@@ -14,8 +14,21 @@ from a daemon thread and publishes it three ways:
   "histograms": ...}``) — tail/grep-able during the run, plottable
   after it;
 - an optional stdlib ``http.server`` endpoint (``tpu_metrics_port``)
-  serving ``GET /metrics`` (Prometheus text) and ``GET /metrics.json``
-  (the raw snapshot) for scraping a live run without touching disk.
+  serving ``GET /metrics`` (Prometheus text), ``GET /metrics.json``
+  (the raw snapshot), ``GET /healthz`` (liveness + last-snapshot age +
+  SLO budget state — the fleet health-check body, JSON, answers 200
+  even before the first snapshot completes) and ``GET /slo`` (the SLO
+  engine's full budget report, obs/slo.py) for scraping a live run
+  without touching disk.
+
+The exporter thread is also the SLO engine's clock: every interval it
+evaluates the armed specs (obs/slo.py) BEFORE snapshotting, so the
+``slo/*`` budget gauges ride the same Prometheus text and JSONL time
+series as everything else, and it feeds each snapshot's counters/
+gauges to the flight recorder's recent-metrics ring (obs/flight.py).
+An ``exporter/last_snapshot_age_s`` gauge makes the exporter's OWN
+staleness observable — a wedged writer thread shows up in the very
+artifacts it stopped writing (and on a live ``/metrics`` scrape).
 
 Config knobs: ``tpu_metrics_export`` (the base path; a ``.prom`` /
 ``.jsonl`` suffix is stripped), ``tpu_metrics_interval_s``,
@@ -100,6 +113,12 @@ def prometheus_text(snapshot: dict) -> str:
         lines.append(f'{base}_bucket{{le="+Inf"}} {h["count"]}')
         lines.append(f"{base}_sum {_fmt(h.get('sum', 0.0))}")
         lines.append(f"{base}_count {h['count']}")
+        # pre-computed p99.9 gauge: fleet-scale tail latency lives
+        # past p99, and histogram_quantile() at p99.9 needs bucket
+        # resolution a scraper cannot assume — export the registry's
+        # own interpolated estimate alongside the native buckets
+        if h.get("p999") is not None:
+            emit(base + "_p999", "gauge", _fmt(h["p999"]))
     return "\n".join(lines) + "\n"
 
 
@@ -133,6 +152,7 @@ class MetricsExporter:
         self._t0 = time.monotonic()
         self.snapshots_written = 0
         self._write_warned = False
+        self._last_snapshot_t: Optional[float] = None   # monotonic
 
     # -- paths ---------------------------------------------------------------
 
@@ -198,14 +218,46 @@ class MetricsExporter:
     # -- snapshot writers ----------------------------------------------------
 
     def _snapshot(self) -> dict:
+        # exporter self-staleness: the age of the last COMPLETED
+        # snapshot, refreshed on every snapshot read (a live /metrics
+        # scrape of a wedged writer thread sees the age growing)
+        if self._last_snapshot_t is not None:
+            self._reg.gauge("exporter/last_snapshot_age_s").set(
+                round(time.monotonic() - self._last_snapshot_t, 3))
         snap = self._reg.snapshot()
         snap["ts"] = round(time.time(), 3)
         snap["uptime_s"] = round(time.monotonic() - self._t0, 3)
         return snap
 
+    def last_snapshot_age_s(self) -> Optional[float]:
+        """Seconds since the last completed snapshot; None before the
+        first one (the /healthz first-scrape race answers null, not a
+        crash)."""
+        if self._last_snapshot_t is None:
+            return None
+        return round(time.monotonic() - self._last_snapshot_t, 3)
+
+    def _evaluate_slo(self) -> None:
+        """The exporter thread IS the SLO engine's clock: evaluate the
+        armed specs so the slo/* budget gauges land in the snapshot
+        written right after (evaluate never raises)."""
+        from . import slo as _slo
+        eng = _slo.global_engine()
+        if eng is not None:
+            eng.evaluate()
+
     def _write_once(self) -> None:
+        self._evaluate_slo()
         if not self.base_path:
-            self.snapshots_written += 1   # HTTP-only mode still ticks
+            # HTTP-only mode: no files, but the tick still snapshots —
+            # the flight recorder's recent-metrics ring must fill
+            # whether or not anything lands on disk
+            from . import flight as _flight
+            fr = _flight.get()
+            if fr is not None:
+                fr.note_metrics(self._snapshot())
+            self.snapshots_written += 1
+            self._last_snapshot_t = time.monotonic()
             return
         try:
             from ..utils import faults
@@ -219,6 +271,13 @@ class MetricsExporter:
             with open(self.jsonl_path, "a") as fh:
                 fh.write(json.dumps(snap) + "\n")
             self.snapshots_written += 1
+            self._last_snapshot_t = time.monotonic()
+            # black-box feed: the flight recorder keeps the last few
+            # interval snapshots' counters/gauges (obs/flight.py)
+            from . import flight as _flight
+            fr = _flight.get()
+            if fr is not None:
+                fr.note_metrics(snap)
         except Exception as e:          # noqa: BLE001 — export is an
             # observability aid; a full disk (or an injected
             # export.write fault) must not take training down — but an
@@ -230,6 +289,56 @@ class MetricsExporter:
                 log.warning("metrics export to %s failing (%s); will "
                             "keep retrying silently", self.base_path, e)
 
+    # -- operational bodies --------------------------------------------------
+
+    def health(self) -> dict:
+        """The ``GET /healthz`` body: liveness, last-snapshot age and
+        the compact SLO budget state. Total by construction — it must
+        answer 200 on the very first scrape, before any snapshot has
+        completed (``last_snapshot_age_s`` is null then) and with no
+        SLO engine armed (``slo`` is null)."""
+        from . import flight as _flight
+        from . import slo as _slo
+        eng = _slo.global_engine()
+        slo_state = None
+        budget_ok = True
+        if eng is not None:
+            try:
+                slo_state = eng.summary()
+                budget_ok = not slo_state.get("exhausted")
+            except Exception:           # noqa: BLE001 — health must
+                slo_state = {"error": "slo summary failed"}
+        alive = not self._stop_ev.is_set()
+        return {
+            "ok": bool(alive and budget_ok),
+            "alive": bool(alive),
+            "budget_ok": bool(budget_ok),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "interval_s": self.interval_s,
+            "snapshots_written": self.snapshots_written,
+            "last_snapshot_age_s": self.last_snapshot_age_s(),
+            "slo": slo_state,
+            "flight_dumps": len(_flight.dump_paths()),
+        }
+
+    def slo_report(self) -> dict:
+        """The ``GET /slo`` body: the engine's full budget report, or
+        an explicit not-armed shape (still 200 — a scraper probing a
+        fleet must distinguish 'no SLOs configured' from 'down').
+
+        Non-mutating: the EXPORTER interval is the engine's clock —
+        a scrape returns the last evaluation (evaluating once only if
+        none has happened yet), so an aggressive external scraper
+        cannot shrink the burn-rate windows or inflate the gauge-tick
+        budgets."""
+        from . import slo as _slo
+        eng = _slo.global_engine()
+        if eng is None:
+            return {"enabled": False, "specs": []}
+        rep = dict(eng.report(fresh=False))
+        rep["enabled"] = True
+        return rep
+
     # -- HTTP ----------------------------------------------------------------
 
     def _start_server(self) -> None:
@@ -239,11 +348,18 @@ class MetricsExporter:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):              # noqa: N802 — stdlib API
-                if self.path.split("?")[0] == "/metrics":
+                route = self.path.split("?")[0]
+                if route == "/metrics":
                     body = prometheus_text(exporter._snapshot())
                     ctype = "text/plain; version=0.0.4"
-                elif self.path.split("?")[0] == "/metrics.json":
+                elif route == "/metrics.json":
                     body = json.dumps(exporter._snapshot())
+                    ctype = "application/json"
+                elif route in ("/healthz", "/health"):
+                    body = json.dumps(exporter.health())
+                    ctype = "application/json"
+                elif route == "/slo":
+                    body = json.dumps(exporter.slo_report())
                     ctype = "application/json"
                 else:
                     self.send_error(404)

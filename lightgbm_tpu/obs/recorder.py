@@ -201,6 +201,16 @@ class RunRecorder:
                     "%.3f s (%.1fx, threshold %.1fx); phase table:\n%s",
                     it, wall_s, med, wall_s / med, self.watchdog_factor,
                     timing.report() or "  (no phases recorded)")
+                # black box: the watchdog firing is a postmortem
+                # moment — dump the flight bundle with the state AT
+                # the stall, not whatever survives to run end
+                # (rate-limited there; obs/flight.py)
+                from . import flight
+                flight.trigger("watchdog",
+                               {"it": int(it),
+                                "wall_s": round(float(wall_s), 6),
+                                "median_s": round(float(med), 6),
+                                "factor": self.watchdog_factor})
         recent.append(float(wall_s))
 
     # -- per-iteration fields ------------------------------------------------
@@ -249,6 +259,14 @@ class RunRecorder:
             trace_path = trace.write()
             if trace_path:
                 self.meta.setdefault("trace_path", trace_path)
+        # cross-link report <-> flight dumps: any postmortem bundle
+        # the black box wrote this process (watchdog, faults, degraded
+        # windows, SLO exhaustion — obs/flight.py) is findable FROM
+        # the run report
+        from . import flight
+        dumps = flight.dump_paths()
+        if dumps:
+            self.meta.setdefault("flight_dumps", dumps)
         if leaves_per_iteration is not None:
             for i, grp in enumerate(leaves_per_iteration):
                 self._rec(i + 1)["leaves"] = [int(x) for x in grp]

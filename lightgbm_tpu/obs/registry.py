@@ -30,6 +30,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Timer", "MetricsRegistry",
     "default_registry", "counter", "gauge", "histogram", "timer",
     "log_buckets", "latency_histogram", "LATENCY_BUCKETS_S",
+    "quantile_label",
 ]
 
 
@@ -96,6 +97,12 @@ def log_buckets(lo: float, hi: float,
 # latency preset: 1 µs .. 60 s — wide enough for a single predict
 # dispatch at the bottom and a cold-compile window wall at the top
 LATENCY_BUCKETS_S: Tuple[float, ...] = log_buckets(1e-6, 60.0, 12)
+
+
+def quantile_label(q: float) -> str:
+    """0.5 -> "p50", 0.95 -> "p95", 0.999 -> "p999" — the one naming
+    rule for quantile keys in snapshots/result tables."""
+    return "p" + f"{q * 100:g}".replace(".", "")
 
 
 class Histogram:
@@ -186,6 +193,46 @@ class Histogram:
                 return lo + (hi - lo) * frac
             return self._max
 
+    def count_le(self, v: float) -> int:
+        """Estimated number of observations <= ``v``: whole buckets
+        below it plus a linear share of the bucket straddling it
+        (the percentile() interpolation run in reverse, same min/max
+        clamping) — the event count the SLO engine's error-budget
+        math stands on (obs/slo.py). 0 when empty."""
+        with self._lock:
+            if not self._count:
+                return 0
+            v = float(v)
+            if self._max is not None and v >= self._max:
+                return self._count
+            if self._min is not None and v < self._min:
+                return 0
+            cum = 0
+            for i, c in enumerate(self._counts):
+                if not c:
+                    continue
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = (self.buckets[i] if i < len(self.buckets)
+                      else self._max)
+                lo = max(lo, self._min)
+                hi = max(min(hi, self._max), lo)
+                if v >= hi:
+                    cum += c
+                    continue
+                if v >= lo:
+                    frac = 1.0 if hi <= lo else (v - lo) / (hi - lo)
+                    cum += int(c * frac)
+                break
+            return cum
+
+    def count_and_le(self, v: float) -> Tuple[int, int]:
+        """Consistent ``(count, count_le(v))`` under ONE lock hold
+        (the lock is reentrant): the SLO engine's bad-event math
+        (``bad = count - count_le``) must not straddle concurrent
+        observes — a racing pair of reads can make it negative."""
+        with self._lock:
+            return self._count, self.count_le(v)
+
     def snapshot(self) -> dict:
         with self._lock:
             counts = list(self._counts)
@@ -195,14 +242,16 @@ class Histogram:
                                zip(self.buckets, counts) if c},
                    "overflow": counts[-1]}
         for q, name in ((0.5, "p50"), (0.9, "p90"), (0.95, "p95"),
-                        (0.99, "p99")):
+                        (0.99, "p99"), (0.999, "p999")):
             out[name] = self.percentile(q)
         return out
 
-    def quantiles(self, qs=(0.5, 0.95, 0.99)) -> dict:
-        """{"p50": v, ...} readout for result tables (bench.py predict
-        latency, lrb.py window wall); values None when empty."""
-        return {f"p{round(q * 100):d}": self.percentile(q) for q in qs}
+    def quantiles(self, qs=(0.5, 0.95, 0.99, 0.999)) -> dict:
+        """{"p50": v, ..., "p999": v} readout for result tables
+        (bench.py predict latency, lrb.py window wall); p99.9 rides
+        along by default — tail latency at fleet scale lives past p99.
+        Values None when empty."""
+        return {quantile_label(q): self.percentile(q) for q in qs}
 
 
 class Timer:

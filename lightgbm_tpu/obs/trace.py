@@ -61,6 +61,7 @@ from ..utils.fileio import atomic_write
 __all__ = [
     "Tracer", "configure", "ensure_from_config", "stop", "active",
     "enabled", "span", "instant", "write", "config_get",
+    "add_sink", "remove_sink",
 ]
 
 
@@ -78,6 +79,54 @@ def config_get(config, key: str, default=None):
 
 DEFAULT_BUFFER_EVENTS = 65536
 MIN_BUFFER_EVENTS = 1024
+
+# event sinks: callables fed EVERY recorded event dict, tracer or not
+# (the flight recorder's always-on span ring, obs/flight.py). Fed
+# outside the tracer's lock; a sink must be cheap and never raise.
+_sinks: list = []
+# fallback clock for sink-only events (no tracer installed): same
+# perf_counter µs convention as Tracer.now_us, epoch at module import
+_sink_t0_ns = time.perf_counter_ns()
+
+
+def add_sink(fn) -> None:
+    """Register an event sink (idempotent — re-registration of the
+    same callable is a no-op)."""
+    if fn not in _sinks:
+        _sinks.append(fn)
+
+
+def remove_sink(fn) -> None:
+    if fn in _sinks:
+        _sinks.remove(fn)
+
+
+def _feed_sinks(ev: dict) -> None:
+    for s in tuple(_sinks):
+        try:
+            s(ev)
+        except Exception:               # noqa: BLE001 — a sink must
+            pass                        # never break the traced path
+
+
+def _sink_only_event(name: str, cat: str, ph: str, ts_us: float,
+                     dur_us: Optional[float] = None,
+                     args: Optional[dict] = None) -> None:
+    """Record an event for the sinks when NO tracer is installed (the
+    flight ring keeps span evidence even with tpu_trace off)."""
+    ev = {"name": name, "cat": cat, "ph": ph, "ts": round(ts_us, 3),
+          "pid": os.getpid(), "tid": _native_tid()}
+    if ph == "X":
+        ev["dur"] = round(max(dur_us or 0.0, 0.0), 3)
+    elif ph == "i":
+        ev["s"] = "t"
+    if args:
+        ev["args"] = args
+    _feed_sinks(ev)
+
+
+def _sink_now_us() -> float:
+    return (time.perf_counter_ns() - _sink_t0_ns) / 1000.0
 
 
 def _native_tid() -> int:
@@ -127,6 +176,7 @@ class Tracer:
             if len(self._events) == self.capacity:
                 self._dropped += 1
             self._events.append(ev)
+        _feed_sinks(ev)                 # outside the ring lock
 
     def _register_thread(self, tid: int) -> None:
         if tid not in self._threads:
@@ -280,10 +330,21 @@ def enabled() -> bool:
 def span(name: str, cat: str = "phase", args: Optional[dict] = None):
     """Record a span on the global tracer; free no-op when tracing is
     off (the hot-path callers — timing.phase, the ingest worker —
-    guard on ``enabled()`` first, but this is safe bare too)."""
+    guard on ``enabled()`` first, but this is safe bare too). With no
+    tracer but registered sinks (the always-on flight ring), the event
+    still reaches the sinks — the black box keeps span evidence even
+    when ``tpu_trace`` is off."""
     tr = _tracer
     if tr is None:
-        yield
+        if not _sinks:
+            yield
+            return
+        t0 = _sink_now_us()
+        try:
+            yield
+        finally:
+            _sink_only_event(name, cat, "X", t0,
+                             dur_us=_sink_now_us() - t0, args=args)
         return
     t0 = tr.now_us()
     try:
@@ -297,6 +358,8 @@ def instant(name: str, cat: str = "event",
     tr = _tracer
     if tr is not None:
         tr.instant(name, cat, args)
+    elif _sinks:
+        _sink_only_event(name, cat, "i", _sink_now_us(), args=args)
 
 
 _write_warned = False

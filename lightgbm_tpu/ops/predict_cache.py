@@ -48,6 +48,7 @@ from collections import OrderedDict
 from typing import Callable, Dict, Optional
 
 from ..obs import registry as obs
+from ..obs import reqlog
 from ..obs import trace
 
 # bounded registry: one entry per distinct predict geometry; LRU evict
@@ -94,13 +95,26 @@ def serve_bucket_rows(n: int, policy: Optional[int] = None) -> int:
     rows are sliced off on the way out.
     0: exact shapes (one trace per distinct batch size — the
     pre-registry behavior).
-    N > 0: round up to a multiple of N."""
+    N > 0: round up to a multiple of N.
+
+    This is the serve-bucket seam of the request log: the chosen width
+    is noted on the calling thread's active request context (free
+    no-op otherwise), so the wide event a serving entry writes carries
+    the bucket its batch dispatched at (obs/reqlog.py). Callers that
+    clamp the answer (stacked_predict's row-chunk ceilings) re-note
+    the clamped width — last note wins, and it is the truth."""
+    b = _bucket_rows(int(n), policy)
+    reqlog.note_bucket(b)
+    return b
+
+
+def _bucket_rows(n: int, policy: Optional[int]) -> int:
     p = (_bucket if policy is None else int(policy))
     if p == 0:
-        return int(n)
+        return n
     if p > 0:
-        return -(-int(n) // p) * p
-    b = max(int(n), SERVE_MIN_BUCKET)
+        return -(-n // p) * p
+    b = max(n, SERVE_MIN_BUCKET)
     if b <= _POW2_CAP:
         return 1 << (b - 1).bit_length()
     return -(-b // (1 << ((b - 1).bit_length() - 4))) \

@@ -57,6 +57,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import autotune, predict_cache
 from ..io.binning import MissingType
+from ..obs import reqlog
 from ..utils import log, timing
 
 # decision_type bit layout (models/tree.py, mirroring tree.h)
@@ -631,6 +632,10 @@ class StackedModel:
             chunk = (fchunk if N > fchunk else min(
                 fchunk, predict_cache.serve_bucket_rows(
                     N, self._serve_policy)))
+            # the request context records the width ACTUALLY
+            # dispatched — the clamp above can shrink the raw
+            # serve-bucket answer for huge batches (obs/reqlog.py)
+            reqlog.note_bucket(chunk)
             _, TCr, Sp, Lp = dev[1].shape
             key = ("pallas", device, offs, Sp, Lp, self.num_class,
                    TCr, dev[0].shape[0], row_tile, dev_bin, m_max,
@@ -678,6 +683,10 @@ class StackedModel:
         # knob: tpu_serve_bucket (ops/predict_cache.py).
         bucket = min(row_chunk, predict_cache.serve_bucket_rows(
             N, self._serve_policy))
+        # record the clamped width the batch actually rides (the raw
+        # serve-bucket answer noted inside serve_bucket_rows can
+        # exceed row_chunk for huge batches)
+        reqlog.note_bucket(bucket)
         TC = dev[1].shape[1]
         key = ("scan", device, offs, self._S, self._L, self.num_class,
                TC, dev[0].shape[0], bool(pred_leaf), dev_bin, m_max,

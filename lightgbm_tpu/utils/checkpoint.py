@@ -83,6 +83,7 @@ VOLATILE_KNOBS = frozenset({
     "tpu_checkpoint_dir", "tpu_checkpoint_freq", "tpu_snapshot_keep",
     "tpu_resume_from", "tpu_faults", "tpu_fault_seed",
     "tpu_retry_attempts",
+    "tpu_reqlog", "tpu_reqlog_sample", "tpu_slo", "tpu_flight_buffer",
 })
 
 

@@ -222,6 +222,15 @@ def check(point: str, context=None) -> None:
     msg = (f"injected fault at {point} occurrence {count}{ctx} "
            f"[action={rule.action}]")
     log.warning("%s", msg)
+    # black box BEFORE the blast: a kill action SIGKILLs the process —
+    # this dump is the only evidence that will ever exist for it
+    # (forced: the moment cannot recur; obs/flight.py)
+    from ..obs import flight
+    flight.trigger("fault", {"point": point, "occurrence": count,
+                             "action": rule.action,
+                             **({"context": str(context)}
+                                if context is not None else {})},
+                   force=rule.action == "kill")
     if rule.action == "kill":
         import signal
         os.kill(os.getpid(), signal.SIGKILL)

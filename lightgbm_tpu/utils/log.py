@@ -36,6 +36,11 @@ _callback = None
 # zero-arg provider -> (run_elapsed_seconds, iteration-or-None) | None;
 # installed by an active RunRecorder, cleared at finish
 _run_context = None
+# additive tee sinks: each receives every emitted line (after the
+# level filter, with the run prefix) WITHOUT re-routing the normal
+# output — the flight recorder's log ring (obs/flight.py). A sink must
+# be cheap and never raise.
+_sinks: list = []
 
 
 def set_level(level: LogLevel | int) -> None:
@@ -63,6 +68,19 @@ def set_run_context(provider) -> None:
         _run_context = provider
 
 
+def add_sink(fn) -> None:
+    """Register a tee sink fed every emitted line (idempotent)."""
+    with _lock:
+        if fn not in _sinks:
+            _sinks.append(fn)
+
+
+def remove_sink(fn) -> None:
+    with _lock:
+        if fn in _sinks:
+            _sinks.remove(fn)
+
+
 def _write(level: LogLevel, tag: str, msg: str) -> None:
     with _lock:
         lvl, cb, ctx = _current_level, _callback, _run_context
@@ -79,6 +97,11 @@ def _write(level: LogLevel, tag: str, msg: str) -> None:
             prefix = (f"[t+{elapsed:.1f}s"
                       + (f" it={it}" if it is not None else "") + "] ")
     line = f"[LightGBM-TPU] [{tag}] {prefix}{msg}"
+    for sink in tuple(_sinks):
+        try:
+            sink(line)
+        except Exception:               # noqa: BLE001 — a sink must
+            pass                        # never break the logged path
     if cb is not None:
         cb(line + "\n")
     else:

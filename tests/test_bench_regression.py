@@ -343,6 +343,73 @@ def test_cli_lrb_stream_walks_back_to_latest_carrier(tmp_path):
     assert cbr.main([str(lost), "--baseline-dir", str(base_dir)]) == 1
 
 
+# -- the slo section (obs/slo.py budget report in bench JSON) ----------------
+
+def _slo_block(**kw):
+    d = {"spec": "predict_p99_ms<5000;degraded_window_rate<0.5",
+         "ok": True, "violating": 0,
+         "budget_remaining_min": 0.98, "burn_rate_max": 0.02,
+         "predict_p999_ms": 41.5, "serve_p999_ms": None,
+         "objectives": [
+             {"name": "predict_p99_ms", "ok": True, "current": 12.0,
+              "threshold": 5000.0, "budget_remaining": 0.98,
+              "burn_rate": 0.02},
+             {"name": "degraded_window_rate", "ok": True,
+              "current": None, "threshold": 0.5,
+              "budget_remaining": 1.0, "burn_rate": 0.0}]}
+    d.update(kw)
+    return d
+
+
+def test_check_schema_slo_section():
+    # a valid section passes; absence is fine too (old artifacts)
+    assert cbr.check_schema(_fresh(slo=_slo_block())) == []
+    assert cbr.check_schema(_fresh()) == []
+    # budget fields must be numeric-or-null, never a string
+    bad = cbr.check_schema(_fresh(
+        slo=_slo_block(budget_remaining_min="lots")))
+    assert any("budget_remaining_min" in p for p in bad)
+    bad = cbr.check_schema(_fresh(slo=_slo_block(burn_rate_max=True)))
+    assert any("burn_rate_max" in p for p in bad)
+    bad = cbr.check_schema(_fresh(slo=_slo_block(predict_p999_ms="x")))
+    assert any("predict_p999_ms" in p for p in bad)
+    # per-objective budget state is REQUIRED, not optional
+    objs = _slo_block()["objectives"]
+    del objs[0]["budget_remaining"]
+    bad = cbr.check_schema(_fresh(slo=_slo_block(objectives=objs)))
+    assert any("objectives[0].budget_remaining" in p for p in bad)
+    bad = cbr.check_schema(_fresh(slo=_slo_block(objectives="none")))
+    assert any("objectives" in p for p in bad)
+    bad = cbr.check_schema(_fresh(slo=_slo_block(ok="yes")))
+    assert any("slo.ok" in p for p in bad)
+    bad = cbr.check_schema(_fresh(slo=[1, 2]))
+    assert any("slo is list" in p for p in bad)
+    # a section that lost its spec string is a shape problem
+    blk = _slo_block()
+    del blk["spec"]
+    assert any("slo.spec" in p for p in cbr.check_schema(
+        _fresh(slo=blk)))
+
+
+def test_slo_violations_are_notes_not_gates():
+    """A violated SLO is an operator signal: field_notes reports it,
+    compare() does not fail on it, and cross-workload refusal still
+    wins over everything."""
+    blk = _slo_block(ok=False, violating=1,
+                     budget_remaining_min=-2.0)
+    blk["objectives"][0]["ok"] = False
+    fresh = _fresh(slo=blk)
+    assert cbr.check_schema(fresh) == []       # shape is still valid
+    notes = cbr.field_notes(fresh)
+    assert any("SLO violations" in n and "predict_p99_ms" in n
+               for n in notes)
+    # same-workload compare ignores the slo values entirely
+    assert cbr.compare(fresh, _fresh(value=50.0)) == []
+    # cross-workload refusal unchanged
+    got = cbr.compare(fresh, _fresh(metric="OTHER"))
+    assert len(got) == 1 and got[0].startswith("not comparable")
+
+
 # -- end-to-end (slow): a real quick bench through the gate ------------------
 
 @pytest.mark.slow

@@ -595,7 +595,7 @@ def test_quantiles_exact_degenerate_cases():
         assert const.percentile(q) == pytest.approx(1.5)
     assert reg.histogram("one").quantiles() == {
         "p50": pytest.approx(1.5), "p95": pytest.approx(1.5),
-        "p99": pytest.approx(1.5)}
+        "p99": pytest.approx(1.5), "p999": pytest.approx(1.5)}
 
 
 # -- live metrics exporter ---------------------------------------------------

@@ -1,0 +1,600 @@
+"""Serving-grade observability (obs/reqlog.py, obs/slo.py,
+obs/flight.py + the lrb/export wiring): request-id issuance and
+deterministic file sampling, SLO error-budget/burn-rate math, the
+``/healthz``/``/slo`` endpoints under a concurrent-scrape hammer
+during a live LRB run, and the flight recorder's trigger matrix —
+watchdog, injected fault, degraded window (the PR-8 drill machinery),
+SLO budget exhaustion, and a SIGTERM subprocess drill.
+
+Run with ``pytest -m obs``.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from lightgbm_tpu import lrb
+from lightgbm_tpu.obs import export as obs_export
+from lightgbm_tpu.obs import flight, reqlog, slo
+from lightgbm_tpu.obs import registry as obs_registry
+from lightgbm_tpu.obs.recorder import RunRecorder
+from lightgbm_tpu.utils import faults, log
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _isolated_serving_obs():
+    """Every test here starts with no armed faults and a fresh (or
+    absent) global flight recorder / SLO engine / request log — the
+    three are process-global by design, and a previous test's dump
+    rate-limit clock or latched budget must not leak in."""
+    faults.clear()
+    flight.shutdown()
+    slo.shutdown()
+    reqlog.shutdown()
+    prev = log.get_level()
+    log.set_level(log.LogLevel.INFO)
+    yield
+    log.set_level(prev)
+    faults.clear()
+    flight.shutdown()
+    slo.shutdown()
+    reqlog.shutdown()
+
+
+# -- request ids + contexts --------------------------------------------------
+
+def test_request_ids_monotonic_across_threads():
+    got = []
+    lock = threading.Lock()
+
+    def worker():
+        mine = [reqlog.next_request_id() for _ in range(200)]
+        with lock:
+            got.extend(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(got) == len(set(got)) == 1600   # unique, none lost
+    later = reqlog.next_request_id()
+    assert later > max(got)                    # monotone issuance
+
+
+def test_request_context_nesting_and_serve_bucket_seam():
+    from lightgbm_tpu.ops import predict_cache
+    assert reqlog.current() is None
+    with reqlog.request(window=4) as outer:
+        assert reqlog.current() is outer
+        assert outer.window == 4 and outer.bucket is None
+        # the serve-bucket seam notes the padded width on the ACTIVE
+        # context (ops/predict_cache.py serve_bucket_rows)
+        b = predict_cache.serve_bucket_rows(3, policy=-1)
+        assert b == 16 and outer.bucket == 16
+        with reqlog.request(req_id=999) as inner:
+            assert reqlog.current() is inner
+            predict_cache.serve_bucket_rows(100, policy=-1)
+            assert inner.bucket == 128
+        assert reqlog.current() is outer       # restored
+        assert outer.bucket == 16              # inner never leaked
+    assert reqlog.current() is None
+    # without a context the seam is a pure function, no crash
+    assert predict_cache.serve_bucket_rows(3, policy=-1) == 16
+
+
+def test_reqlog_sampling_deterministic():
+    a = reqlog.RequestLog(sample=0.25,
+                          registry=obs_registry.MetricsRegistry())
+    b = reqlog.RequestLog(sample=0.25,
+                          registry=obs_registry.MetricsRegistry())
+    decisions = [a.sampled(i) for i in range(8192)]
+    # a pure function of (id, rate): a second instance agrees exactly
+    assert decisions == [b.sampled(i) for i in range(8192)]
+    frac = sum(decisions) / len(decisions)
+    assert 0.2 < frac < 0.3                    # ~rate, hash-uniform
+    full = reqlog.RequestLog(sample=1.0,
+                             registry=obs_registry.MetricsRegistry())
+    none = reqlog.RequestLog(sample=0.0,
+                             registry=obs_registry.MetricsRegistry())
+    assert all(full.sampled(i) for i in range(100))
+    assert not any(none.sampled(i) for i in range(100))
+
+
+def test_reqlog_file_ring_and_always_logged_kinds(tmp_path):
+    path = str(tmp_path / "req.jsonl")
+    reg = obs_registry.MetricsRegistry()
+    rl = reqlog.RequestLog(path, sample=0.0, ring_records=64,
+                           registry=reg)
+    for i in range(5):
+        rl.record("request", req_id=i + 1, rows=8, latency_ms=1.0)
+    rl.record("window", window=1, fp_rate=0.1)
+    rl.record("degraded_window", window=2, label="budget")
+    rl.close()
+    lines = [json.loads(ln) for ln in open(path)]
+    # header + the two always-logged kinds; sample=0 drops every
+    # request record from the FILE...
+    assert [ln["kind"] for ln in lines] == ["header", "window",
+                                            "degraded_window"]
+    assert lines[0]["schema"] == reqlog.REQLOG_SCHEMA
+    assert lines[0]["version"] == reqlog.REQLOG_VERSION
+    # ...but the ring (the flight recorder's feed) kept everything
+    kinds = [r["kind"] for r in rl.recent()]
+    assert kinds.count("request") == 5
+    assert reg.counter("reqlog/records").value == 7
+
+
+def test_reqlog_write_failure_never_raises(tmp_path):
+    reg = obs_registry.MetricsRegistry()
+    bad = str(tmp_path / "dir-as-file")
+    os.mkdir(bad)                              # open(bad, "a") fails
+    rl = reqlog.RequestLog(bad, registry=reg)
+    rl.record("window", window=1)              # must not raise
+    assert reg.counter("reqlog/write_failures").value == 1
+    assert rl.recent()                          # ring still records
+
+
+# -- SLO engine: parsing + budget math ---------------------------------------
+
+def test_slo_parse_named_generic_and_errors():
+    specs = slo.parse_specs(
+        "predict_p99_ms<50; serve_p999_ms <= 20;"
+        "window_wall_p95_s<30;staleness_windows<=2;"
+        "degraded_window_rate<0.05;hist:a/b:p90>0.1;"
+        "gauge:x/y<=3;ratio:n/a|d/b<0.2")
+    kinds = [(s.name, s.kind) for s in specs]
+    assert ("predict_p99_ms", "quantile") in kinds
+    assert ("staleness_windows", "gauge") in kinds
+    assert ("degraded_window_rate", "ratio") in kinds
+    by_name = {s.name: s for s in specs}
+    assert by_name["predict_p99_ms"].objective == 0.99
+    assert by_name["serve_p999_ms"].objective == 0.999
+    assert by_name["predict_p99_ms"].threshold_s == pytest.approx(0.05)
+    assert by_name["degraded_window_rate"].source_den == \
+        "lrb/windows_total"
+    for bad in ("predict_p99_ms=50",           # no operator
+                "predict_p99_s<50",            # wrong unit
+                "nonsense<1",                  # unknown indicator
+                "degraded_window_rate<5",      # rate outside (0,1]
+                "hist:x:q99<1",                # malformed quantile
+                "ratio:only_num<0.1",          # no denominator
+                "predict_p100_ms<50",          # p100 is not a quantile
+                "hist:x:p500<1",               # p500 must not alias p50
+                "predict_p99_ms<abc"):         # non-numeric threshold
+        with pytest.raises(ValueError):
+            slo.parse_specs(bad)
+    assert slo.parse_specs("") == []
+
+
+def test_slo_quantile_budget_and_burn_math():
+    """The unit math: 2 bad of 100 events under a p99 objective means
+    the 1%-of-events budget is 2x overspent (remaining -1.0) and the
+    first interval burned at 2x; a later all-good interval burns 0 and
+    refills the cumulative remaining to 0.5 at 400 events."""
+    reg = obs_registry.MetricsRegistry()
+    h = obs_registry.latency_histogram("t/lat", reg)
+    for v in [0.001] * 98 + [1.0] * 2:
+        h.observe(v)
+    eng = slo.SloEngine.from_spec("hist:t/lat:p99<0.1", registry=reg)
+    row = eng.evaluate()["specs"][0]
+    assert row["events"] == 100 and row["bad_events"] == 2
+    assert row["budget_remaining"] == pytest.approx(-1.0)
+    assert row["burn_rate"] == pytest.approx(2.0)
+    assert row["ok"] is False and row["exhausted"] is True
+    for _ in range(300):
+        h.observe(0.001)
+    row = eng.evaluate()["specs"][0]
+    assert row["events"] == 400 and row["bad_events"] == 2
+    # delta interval was all-good: instantaneous burn 0
+    assert row["burn_rate"] == pytest.approx(0.0)
+    assert row["budget_remaining"] == pytest.approx(0.5)
+    assert row["ok"] is True
+    assert row["exhausted"] is True            # the latch holds
+    # the budget state became first-class gauges
+    assert reg.gauge("slo/t_lat_p99/budget_remaining").value == \
+        pytest.approx(0.5)
+    assert reg.gauge("slo/t_lat_p99/ok").value == 1.0
+
+
+def test_slo_ratio_budget_math():
+    reg = obs_registry.MetricsRegistry()
+    reg.counter("lrb/windows_degraded").add(1)
+    reg.counter("lrb/windows_total").add(10)
+    eng = slo.SloEngine.from_spec("degraded_window_rate<0.5",
+                                  registry=reg)
+    row = eng.evaluate()["specs"][0]
+    assert row["current"] == pytest.approx(0.1)
+    assert row["ok"] is True
+    # budget = thr * den = 5 degraded windows allowed; 1 spent
+    assert row["budget_remaining"] == pytest.approx(0.8)
+    assert row["burn_rate"] == pytest.approx(0.2)
+
+
+def test_slo_gauge_ticks_and_empty_registry():
+    reg = obs_registry.MetricsRegistry()
+    eng = slo.SloEngine.from_spec(
+        "staleness_windows<=2;hist:none:p99<1;"
+        "degraded_window_rate<0.5", registry=reg)
+    rep = eng.evaluate()
+    # nothing observed anywhere: every budget intact, nothing violating
+    assert rep["ok"] is True
+    assert rep["budget_remaining_min"] == pytest.approx(1.0)
+    reg.gauge("lrb/model_staleness_windows").set(5.0)
+    rep = eng.evaluate()
+    row = [r for r in rep["specs"]
+           if r["name"] == "staleness_windows"][0]
+    assert row["ok"] is False and row["current"] == 5.0
+    assert row["bad_events"] == 1              # one bad tick
+    assert rep["violating"] == 1
+
+
+def test_slo_exhaustion_triggers_flight_once(tmp_path):
+    fr = flight.configure(capacity=32, directory=str(tmp_path),
+                          min_dump_interval_s=0.0)
+    h = obs_registry.latency_histogram("t/exh")   # default registry
+    h.observe(5.0)                                # 1 bad of 1 event
+    eng = slo.configure("hist:t/exh:p99<0.1")
+    eng.evaluate()
+    dumps = fr.dump_paths()
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "slo_budget_exhausted"
+    assert doc["context"]["slo"] == "t_exh_p99"
+    eng.evaluate()                                # latched: no re-dump
+    assert len(fr.dump_paths()) == 1
+
+
+# -- /healthz + /slo ---------------------------------------------------------
+
+def _get(url, timeout=10):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type"), r.read()
+
+
+def test_healthz_first_scrape_race_and_slo_endpoint(tmp_path):
+    """/healthz answers 200 JSON BEFORE the first snapshot completes
+    (last_snapshot_age_s null), and /slo distinguishes 'not armed'
+    from 'down'."""
+    from lightgbm_tpu.obs.export import MetricsExporter
+    ex = MetricsExporter(base_path=str(tmp_path / "m"), interval_s=60,
+                         port=0, registry=obs_registry.MetricsRegistry())
+    ex._start_server()                 # server up, NO snapshot yet
+    try:
+        url = f"http://127.0.0.1:{ex.http_port}"
+        status, ctype, body = _get(f"{url}/healthz")
+        assert status == 200 and ctype == "application/json"
+        h = json.loads(body)
+        assert h["ok"] is True and h["alive"] is True
+        assert h["last_snapshot_age_s"] is None
+        assert h["snapshots_written"] == 0
+        assert h["slo"] is None
+        status, ctype, body = _get(f"{url}/slo")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body) == {"enabled": False, "specs": []}
+        # arm an SLO and scrape again: the report flows through
+        obs_registry.gauge("t/healthz_g").set(0.0)
+        slo.configure("gauge:t/healthz_g<=1")
+        rep = json.loads(_get(f"{url}/slo")[2])
+        assert rep["enabled"] is True and len(rep["specs"]) == 1
+        assert rep["specs"][0]["ok"] is True
+        h = json.loads(_get(f"{url}/healthz")[2])
+        assert h["slo"]["specs"] == 1 and h["budget_ok"] is True
+    finally:
+        ex.stop(final_snapshot=False)
+
+
+def test_exporter_snapshot_age_gauge_and_slo_evaluation(tmp_path):
+    """The exporter thread IS the SLO clock: budgets are evaluated
+    every interval (gauges land in the written snapshots) and the
+    exporter's own staleness is a gauge."""
+    from lightgbm_tpu.obs.export import MetricsExporter
+    obs_registry.gauge("t/exp_g").set(0.0)
+    eng = slo.configure("gauge:t/exp_g<=1")
+    ex = MetricsExporter(base_path=str(tmp_path / "live"),
+                         interval_s=0.05).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while ex.snapshots_written < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ex.snapshots_written >= 3
+        assert eng._evaluations >= 2           # the thread evaluated
+        assert ex.last_snapshot_age_s() is not None
+        rows = [json.loads(ln) for ln in open(ex.jsonl_path)]
+        last = rows[-1]["gauges"]
+        assert last["slo/t_exp_g/ok"] == 1.0
+        assert last["slo/t_exp_g/budget_remaining"] == 1.0
+        assert "exporter/last_snapshot_age_s" in last
+        # /healthz through the running exporter reports the age too
+    finally:
+        ex.stop()
+
+
+def test_concurrent_scrape_hammer_during_live_lrb_run(tmp_path):
+    """N threads hammer /metrics, /metrics.json, /healthz and /slo
+    while a real (pipelined) LRB loop trains/serves — every response
+    must be 200 and parseable; no torn bodies, no 500s."""
+    import io
+    import urllib.request
+
+    from lightgbm_tpu.obs.export import MetricsExporter
+    slo.configure("serve_p99_ms<60000;degraded_window_rate<0.9;"
+                  "staleness_windows<=8")
+    ex = MetricsExporter(interval_s=0.05, port=0).start()
+    url = f"http://127.0.0.1:{ex.http_port}"
+    stop = threading.Event()
+    failures: list = []
+    hits = [0]
+
+    def hammer():
+        routes = ("/metrics", "/metrics.json", "/healthz", "/slo")
+        i = 0
+        while not stop.is_set():
+            route = routes[i % len(routes)]
+            i += 1
+            try:
+                with urllib.request.urlopen(url + route,
+                                            timeout=10) as r:
+                    body = r.read()
+                    if r.status != 200:
+                        failures.append((route, r.status))
+                    elif route != "/metrics":
+                        json.loads(body)
+                    elif b"_total" not in body and b"# TYPE" not in body:
+                        failures.append((route, "empty prom body"))
+                hits[0] += 1
+            except Exception as e:      # noqa: BLE001 — collected
+                failures.append((route, repr(e)))
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        drv = lrb.LrbDriver(1 << 16, 200, 100, 0.5, 1,
+                            result_file=io.StringIO(),
+                            extra_params={"num_iterations": 2,
+                                          "verbose": "-1"})
+        for req in lrb.synthetic_trace(400, 50):
+            drv.process_request(*req)
+        drv.drain()
+        drv.close()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        ex.stop(final_snapshot=False)
+    assert not failures, failures[:5]
+    assert hits[0] >= 20                       # the hammer really ran
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_flight_rings_bounded_and_dump_schema_round_trip(tmp_path):
+    reg = obs_registry.MetricsRegistry()
+    reg.counter("t/c").add(3)
+    fr = flight.FlightRecorder(capacity=16, directory=str(tmp_path),
+                               registry=reg, min_dump_interval_s=0.0)
+    for i in range(50):                        # ring keeps newest 16
+        fr.note_span({"name": f"s{i}", "ph": "X", "ts": i, "dur": 1,
+                      "pid": 1, "tid": 1})
+        fr.note_log(f"line {i}")
+    fr.note_metrics({"ts": 1.0, "uptime_s": 2.0,
+                     "counters": {"t/c": 3}, "gauges": {},
+                     "histograms": {"dropped": {}}})
+    path = fr.trigger("watchdog", {"it": 9})
+    assert path and os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["schema"] == flight.FLIGHT_SCHEMA
+    assert doc["version"] == flight.FLIGHT_VERSION
+    assert doc["reason"] == "watchdog"
+    assert doc["context"] == {"it": 9}
+    assert len(doc["spans"]) == 16
+    assert doc["spans"][-1]["name"] == "s49"   # newest kept
+    assert len(doc["log_lines"]) == 16
+    assert doc["metrics"]["current"]["counters"]["t/c"] == 3
+    # compacted exporter snapshots: histograms dropped, counters kept
+    assert doc["metrics"]["recent"][0]["counters"] == {"t/c": 3}
+    assert "histograms" not in doc["metrics"]["recent"][0]
+    assert doc["triggers"][-1]["reason"] == "watchdog"
+    assert reg.counter("flight/dumps").value == 1
+
+
+def test_flight_rate_limit_force_and_pending_sweep(tmp_path):
+    reg = obs_registry.MetricsRegistry()
+    fr = flight.FlightRecorder(capacity=16, directory=str(tmp_path),
+                               registry=reg,
+                               min_dump_interval_s=3600.0)
+    assert fr.trigger("degraded_window") is not None
+    # within the interval: coalesced, recorded, not dumped
+    assert fr.trigger("degraded_window") is None
+    assert reg.counter("flight/dumps_suppressed").value == 1
+    assert len(fr.dump_paths()) == 1
+    # force bypasses the interval (SIGTERM / kill faults / exhaustion)
+    assert fr.trigger("sigterm", force=True) is not None
+    # a coalesced trigger is swept at exit, not lost
+    assert fr.trigger("watchdog") is None
+    swept = fr.sweep_pending()
+    assert swept is not None
+    assert json.load(open(swept))["reason"] == "watchdog"
+    assert fr.sweep_pending() is None          # nothing pending now
+    # the cap stops a runaway non-forced trigger loop — but a forced
+    # moment (SIGTERM, kill fault) still leaves its bundle: a capped
+    # process must not die evidence-less
+    fr2 = flight.FlightRecorder(capacity=16, directory=str(tmp_path),
+                                registry=reg, min_dump_interval_s=0.0,
+                                max_dumps=2)
+    assert fr2.trigger("a") and fr2.trigger("b")
+    assert fr2.trigger("c") is None
+    assert fr2.trigger("kill_fault", force=True) is not None
+    assert len(fr2.dump_paths()) == 3
+
+
+def test_watchdog_firing_dumps_flight(tmp_path):
+    fr = flight.configure(capacity=64, directory=str(tmp_path),
+                          min_dump_interval_s=0.0)
+    rec = RunRecorder(watchdog_factor=3.0,
+                      registry=obs_registry.MetricsRegistry()).start()
+    for i in range(8):
+        rec.observe_iteration(i + 1, 0.01)
+    rec.observe_iteration(9, 10.0)             # 1000x the median
+    rec.finish()
+    dumps = fr.dump_paths()
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "watchdog"
+    assert doc["context"]["it"] == 9
+
+
+def test_fault_injection_dumps_flight(tmp_path):
+    fr = flight.configure(capacity=64, directory=str(tmp_path),
+                          min_dump_interval_s=0.0)
+    faults.configure("train.iter@1")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("train.iter", context=1)
+    dumps = fr.dump_paths()
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert doc["reason"] == "fault"
+    assert doc["context"]["point"] == "train.iter"
+    assert doc["context"]["action"] == "raise"
+
+
+def test_run_report_cross_links_flight_dumps(tmp_path):
+    fr = flight.configure(capacity=64, directory=str(tmp_path),
+                          min_dump_interval_s=0.0)
+    p = fr.trigger("degraded_window", {"window": 2})
+    rec = RunRecorder(path=str(tmp_path / "report.json"),
+                      registry=obs_registry.MetricsRegistry()).start()
+    report = rec.finish()
+    assert report["meta"]["flight_dumps"] == [p]
+    on_disk = json.load(open(tmp_path / "report.json"))
+    assert on_disk["meta"]["flight_dumps"] == [p]
+
+
+def test_degraded_lrb_window_drill(tmp_path):
+    """The acceptance drill: a fault-injected lrb run (PR-8 machinery)
+    degrades one window and the black box captures it — a dump with
+    the failing window's spans, reqlog wide events and SLO budget
+    state; the degraded reason lands as a labeled counter family AND
+    a wide event in the reqlog file (never sampled out)."""
+    import io
+    reqpath = str(tmp_path / "req.jsonl")
+    reg = obs_registry.default_registry()
+    c0 = reg.counter("lrb/degraded_reason/injected_fault").value
+    t0 = reg.counter("lrb/windows_total").value
+    drv = lrb.LrbDriver(
+        1 << 16, 200, 100, 0.5, 1, result_file=io.StringIO(),
+        extra_params={
+            "num_iterations": 2, "verbose": "-1",
+            "tpu_reqlog": reqpath,
+            "tpu_reqlog_sample": 0.0,          # windows still logged
+            "tpu_slo": ("serve_p99_ms<60000;degraded_window_rate<0.9;"
+                        "staleness_windows<=8"),
+            "tpu_faults": "lrb.window_train@2",
+            "tpu_lrb_pipeline": 0})
+    for req in lrb.synthetic_trace(600, 50):
+        drv.process_request(*req)
+    drv.drain()
+    drv.close()
+    res = drv.results
+    bad = [r for r in res if r.get("degraded")]
+    assert len(bad) == 1 and bad[0]["window"] == 2
+    assert bad[0]["degrade_label"] == "injected_fault"
+    # labeled counter family: WHY, not just THAT
+    assert reg.counter(
+        "lrb/degraded_reason/injected_fault").value == c0 + 1
+    assert reg.counter("lrb/windows_total").value == t0 + 3
+    # the black box dumped (fault trigger and/or degraded-window
+    # trigger — one incident coalesces to one bundle)
+    assert drv.flight_dumps
+    doc = json.load(open(drv.flight_dumps[0]))
+    assert doc["reason"] in ("fault", "degraded_window")
+    span_names = {e.get("name") for e in doc["spans"]}
+    assert "lrb/train" in span_names
+    assert "serve/request" in span_names       # the failing window's
+    # requests with their ids are in the bundle
+    reqs = [r for r in doc["reqlog"] if r["kind"] == "request"]
+    assert reqs and all("req_id" in r for r in reqs)
+    assert doc["slo"] is not None and doc["slo"]["specs"]
+    # the reqlog FILE carries the degraded window despite sample=0
+    kinds = [json.loads(ln)["kind"] for ln in open(reqpath)]
+    assert "degraded_window" in kinds and "window" in kinds
+    assert "request" not in kinds              # sampled out of file
+
+
+def test_sigterm_subprocess_drill(tmp_path):
+    """SIGTERM is a trigger: the dying process leaves a postmortem
+    bundle (forced — the moment cannot recur) and still exits by
+    signal."""
+    child = (
+        "import sys, time\n"
+        "from lightgbm_tpu.obs import flight\n"
+        "flight.configure(capacity=32, directory=sys.argv[1],\n"
+        "                 min_dump_interval_s=0.0)\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(60)\n")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child, str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.terminate()                       # SIGTERM
+        proc.wait(timeout=30)
+    finally:
+        proc.kill()
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flight_") and "sigterm" in f]
+    assert len(dumps) == 1
+    doc = json.load(open(tmp_path / dumps[0]))
+    assert doc["reason"] == "sigterm"
+    assert proc.returncode != 0                # died BY the signal
+
+
+def test_flight_disabled_by_knob():
+    assert flight.configure(capacity=0) is None
+    assert flight.trigger("watchdog") is None  # no-op, no crash
+    assert flight.ensure_from_config({"tpu_flight_buffer": "0"}) is None
+    assert flight.get() is None
+
+
+# -- registry satellite: p99.9 + count_le ------------------------------------
+
+def test_histogram_p999_snapshot_and_prometheus():
+    reg = obs_registry.MetricsRegistry()
+    h = obs_registry.latency_histogram("t/p999", reg)
+    for v in [0.001] * 995 + [2.0] * 5:
+        h.observe(v)
+    q = h.quantiles()
+    assert set(q) == {"p50", "p95", "p99", "p999"}
+    assert q["p99"] < 1.0 < q["p999"] <= 2.0   # the tail past p99
+    snap = reg.snapshot()["histograms"]["t/p999"]
+    assert snap["p999"] == pytest.approx(q["p999"])
+    text = obs_export.prometheus_text(reg.snapshot())
+    assert "lgbm_tpu_t_p999_p999" in text
+    # percentile() semantics unchanged: p50 is still the bulk
+    assert h.percentile(0.5) == pytest.approx(0.001, rel=0.3)
+
+
+def test_histogram_count_le():
+    reg = obs_registry.MetricsRegistry()
+    h = obs_registry.latency_histogram("t/cle", reg)
+    assert h.count_le(1.0) == 0                # empty
+    for v in [0.001] * 90 + [1.0] * 10:
+        h.observe(v)
+    assert h.count_le(2.0) == 100              # >= max: everything
+    assert h.count_le(1e-9) == 0               # < min: nothing
+    assert h.count_le(0.1) == 90               # between the modes
+    # monotone in v
+    vals = [h.count_le(v) for v in (1e-4, 1e-3, 1e-2, 0.5, 1.0, 5.0)]
+    assert vals == sorted(vals)
+    # the one-lock pair the SLO engine reads (bad = total - le can
+    # never go negative within one call)
+    assert h.count_and_le(0.1) == (100, 90)

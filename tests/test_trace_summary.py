@@ -1,0 +1,162 @@
+"""tools/trace_summary.py — the human side of the black box: format
+sniffing across trace/flight/reqlog artifacts, the per-thread span
+table math, top-N slow-request selection (wide events first, spans
+fallback), and the CLI's exit codes.
+
+Run with ``pytest -m obs``.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+import trace_summary  # noqa: E402
+
+pytestmark = pytest.mark.obs
+
+
+def _trace_doc():
+    return {
+        "traceEvents": [
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": 7,
+             "args": {"name": "lrb-trainer"}},
+            {"name": "lrb/train", "cat": "window", "ph": "X",
+             "ts": 0.0, "dur": 4000.0, "pid": 1, "tid": 7},
+            {"name": "lrb/train", "cat": "window", "ph": "X",
+             "ts": 5000.0, "dur": 2000.0, "pid": 1, "tid": 7},
+            {"name": "serve/request", "cat": "serve", "ph": "X",
+             "ts": 100.0, "dur": 1500.0, "pid": 1, "tid": 9,
+             "args": {"req_id": 3, "window": 2, "rows": 64}},
+            {"name": "serve/request", "cat": "serve", "ph": "X",
+             "ts": 2000.0, "dur": 500.0, "pid": 1, "tid": 9,
+             "args": {"req_id": 4, "window": 2, "rows": 64}},
+            {"name": "watchdog/slow_iteration", "ph": "i", "s": "t",
+             "ts": 50.0, "pid": 1, "tid": 9},
+        ],
+        "otherData": {"schema": "lightgbm-tpu/trace", "version": 1,
+                      "dropped_events": 2},
+    }
+
+
+def test_load_and_summarize_trace(tmp_path):
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(_trace_doc()))
+    kind, doc = trace_summary.load_artifact(str(p))
+    assert kind == "trace"
+    rows = trace_summary.span_table(doc["events"])
+    # hottest-first; metadata names resolved; instants excluded
+    assert rows[0]["span"] == "lrb/train"
+    assert rows[0]["thread"] == "lrb-trainer"
+    assert rows[0]["count"] == 2
+    assert rows[0]["total_ms"] == pytest.approx(6.0)
+    assert rows[0]["max_ms"] == pytest.approx(4.0)
+    assert rows[0]["mean_ms"] == pytest.approx(3.0)
+    assert rows[1]["span"] == "serve/request"
+    assert rows[1]["thread"] == "tid 9"        # no metadata for tid 9
+    assert len(rows) == 2
+    # spans FALLBACK for top requests (no wide events in a trace)
+    reqs = trace_summary.top_requests(doc, 5)
+    assert [r["req_id"] for r in reqs] == [3, 4]   # latency desc
+    assert reqs[0]["latency_ms"] == pytest.approx(1.5)
+    assert reqs[0]["window"] == 2
+    out = trace_summary.render(kind, doc)
+    assert "dropped 2 older events" in out
+    assert "lrb-trainer" in out and "req_id" in out
+
+
+def test_load_and_summarize_reqlog(tmp_path):
+    p = tmp_path / "req.jsonl"
+    lines = [
+        {"kind": "header", "schema": "lightgbm-tpu/reqlog",
+         "version": 1},
+        {"kind": "request", "req_id": 1, "latency_ms": 5.0,
+         "path": "lrb/serve", "window": 1, "rows": 64,
+         "serve_bucket": 64, "model_window": 0},
+        {"kind": "request", "req_id": 2, "latency_ms": 50.0,
+         "path": "lrb/serve", "window": 2, "rows": 64,
+         "serve_bucket": 64, "model_window": 1},
+        {"kind": "request", "req_id": 3, "latency_ms": 1.0,
+         "path": "lrb/live", "window": 2, "rows": 8},
+        {"kind": "window", "window": 1, "train_s": 2.0,
+         "window_wall_s": 2.5, "fp_rate": 0.1, "fn_rate": 0.0},
+        {"kind": "degraded_window", "window": 2,
+         "label": "budget", "degrade_label": "budget"},
+    ]
+    p.write_text("".join(json.dumps(ln) + "\n" for ln in lines)
+                 + "not json\n")                # skipped, not fatal
+    kind, doc = trace_summary.load_artifact(str(p))
+    assert kind == "reqlog"
+    assert len(doc["records"]) == 5            # header + garbage gone
+    reqs = trace_summary.top_requests(doc, 2)  # top-N honors N
+    assert [r["req_id"] for r in reqs] == [2, 1]
+    out = trace_summary.render(kind, doc)
+    assert "top 2 slow requests" not in out    # default top=10
+    assert "window records (2)" in out
+    assert "budget" in out
+
+
+def test_load_and_summarize_flight_dump(tmp_path):
+    doc = {
+        "schema": "lightgbm-tpu/flight", "version": 1,
+        "created_unix": 1.0, "pid": 42, "reason": "degraded_window",
+        "context": {"window": 2, "label": "budget"},
+        "triggers": [{"ts": 1.0, "reason": "degraded_window"}],
+        "spans": _trace_doc()["traceEvents"],
+        "log_lines": ["[LightGBM-TPU] [Warning] w"],
+        "reqlog": [{"kind": "request", "req_id": 9,
+                    "latency_ms": 3.25, "window": 2, "rows": 16}],
+        "metrics": {"current": {"counters": {}}, "recent": []},
+        "slo": None,
+    }
+    p = tmp_path / "flight_p42_001_degraded_window.json"
+    p.write_text(json.dumps(doc))
+    kind, loaded = trace_summary.load_artifact(str(p))
+    assert kind == "flight"
+    out = trace_summary.render(kind, loaded)
+    assert "reason=degraded_window" in out
+    assert "triggers:" in out
+    # wide events win over the spans fallback when both are present
+    reqs = trace_summary.top_requests(loaded, 5)
+    assert [r["req_id"] for r in reqs] == [9]
+    assert "lrb-trainer" in out                # span table still there
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    good = tmp_path / "trace.json"
+    good.write_text(json.dumps(_trace_doc()))
+    assert trace_summary.main([str(good), "--top", "3"]) == 0
+    assert "trace artifact" in capsys.readouterr().out
+    bad = tmp_path / "noise.txt"
+    bad.write_text("definitely not an artifact\n")
+    assert trace_summary.main([str(bad)]) == 2
+    assert trace_summary.main([str(tmp_path / "missing.json")]) == 2
+    empty_json = tmp_path / "other.json"
+    empty_json.write_text(json.dumps({"some": "dict"}))
+    assert trace_summary.main([str(empty_json)]) == 2
+
+
+def test_real_artifacts_round_trip(tmp_path):
+    """A trace written by the real Tracer and a reqlog written by the
+    real RequestLog summarize without special-casing."""
+    from lightgbm_tpu.obs import registry as obs_registry
+    from lightgbm_tpu.obs import reqlog as rl
+    from lightgbm_tpu.obs import trace as tr
+    t = tr.Tracer(str(tmp_path / "t.json"))
+    with t.span("serve/request", cat="serve",
+                args={"req_id": 1, "rows": 4}):
+        pass
+    t.write()
+    kind, doc = trace_summary.load_artifact(str(tmp_path / "t.json"))
+    assert kind == "trace"
+    assert trace_summary.span_table(doc["events"])
+    log = rl.RequestLog(str(tmp_path / "r.jsonl"),
+                        registry=obs_registry.MetricsRegistry())
+    log.record("request", req_id=1, latency_ms=2.0, rows=4)
+    log.record("window", window=1, window_wall_s=0.5)
+    log.close()
+    kind, doc = trace_summary.load_artifact(str(tmp_path / "r.jsonl"))
+    assert kind == "reqlog"
+    assert trace_summary.top_requests(doc, 5)[0]["req_id"] == 1

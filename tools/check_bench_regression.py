@@ -17,6 +17,12 @@ the numbers. This tool makes the comparison mechanical:
   shared hosts are far noisier than throughput) of the latest baseline
   that CARRIES the quantiles; trajectory points predating the field are
   skipped, never treated as a zero-latency baseline;
+- **SLO section**: a fresh run carrying an ``slo`` section (obs/slo.py
+  budget report: remaining error budget, burn rate, p99.9 tails) has
+  its SHAPE validated — budget fields numeric-or-null, per-objective
+  budget state present; values are reported as notes, never gated
+  (compliance on a shared host is an operator signal, not a perf
+  regression);
 - **comparability**: the bench ``metric`` string embeds the workload
   shape (rows x features, leaves, bins, iters, chips) — a quick run is
   refused against a full-size baseline instead of "passing" a
@@ -166,6 +172,51 @@ def check_schema(fresh: dict) -> List[str]:
             for q in ("p50_ms", "p95_ms", "p99_ms"):
                 if not isinstance(lat.get(q), (int, float)):
                     problems.append(f"predict_latency.{q} missing/null")
+    problems += _check_slo_schema(fresh.get("slo"))
+    return problems
+
+
+def _check_slo_schema(slo) -> List[str]:
+    """Shape problems in the bench ``slo`` section (obs/slo.py budget
+    report): the budget fields must be numeric (or null where a tail
+    legitimately has no events yet) and the per-objective rows must
+    carry their budget state — an artifact that LOST the budget math
+    must not pass as "no SLOs configured". Values are NOT gated:
+    compliance on a shared host is an operator signal, not a perf
+    regression."""
+    if slo is None:
+        return []
+    if not isinstance(slo, dict):
+        return [f"slo is {type(slo).__name__}, not a dict"]
+    problems = []
+    if not isinstance(slo.get("spec"), str):
+        problems.append("slo.spec missing/not a string")
+    if not isinstance(slo.get("ok"), bool):
+        problems.append("slo.ok missing/not a bool")
+    for k in ("budget_remaining_min", "burn_rate_max",
+              "predict_p999_ms", "serve_p999_ms"):
+        v = slo.get(k)
+        if v is not None and not (isinstance(v, (int, float))
+                                  and not isinstance(v, bool)):
+            problems.append(
+                f"slo.{k} is {type(v).__name__}, not numeric/null")
+    objs = slo.get("objectives")
+    if not isinstance(objs, list):
+        problems.append("slo.objectives missing/not a list")
+        return problems
+    for i, o in enumerate(objs):
+        if not isinstance(o, dict):
+            problems.append(f"slo.objectives[{i}] is "
+                            f"{type(o).__name__}, not a dict")
+            continue
+        if not isinstance(o.get("name"), str):
+            problems.append(f"slo.objectives[{i}].name missing")
+        for k in ("budget_remaining", "burn_rate"):
+            v = o.get(k)
+            if not (isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                problems.append(
+                    f"slo.objectives[{i}].{k} missing/not numeric")
     return problems
 
 
@@ -193,6 +244,16 @@ def field_notes(doc: dict) -> List[str]:
         else:
             notes.append(f"checkpoint meta present but "
                          f"{type(ck).__name__}, not an object — ignored")
+    slo = doc.get("slo")
+    if isinstance(slo, dict) and slo.get("ok") is False:
+        # an operator signal, not a perf gate (shared-host runs
+        # violate latency SLOs on scheduling noise alone)
+        bad = [o.get("name") for o in slo.get("objectives", [])
+               if isinstance(o, dict) and o.get("ok") is False]
+        notes.append(
+            f"SLO violations reported by this run: "
+            f"{', '.join(str(b) for b in bad) or 'unknown'} "
+            f"(budget_remaining_min={slo.get('budget_remaining_min')})")
     return notes
 
 

@@ -1,0 +1,243 @@
+"""Render a trace / flight-dump / request-log file for humans: a
+per-thread span table and the top-N slow requests.
+
+The black box half of the observability stack writes three machine
+artifacts — Chrome trace-event JSON (obs/trace.py, ``tpu_trace``),
+flight-recorder postmortem bundles (obs/flight.py), and the
+request-log JSONL (obs/reqlog.py, ``tpu_reqlog``). This tool is the
+human side: point it at ANY of the three (the format is sniffed from
+the content, never the file name) and it prints
+
+- a **per-thread span table** — thread name, span name, call count,
+  total/mean/max milliseconds, sorted hottest-first — the "what was
+  every thread doing" answer without loading Perfetto;
+- the **top-N slow requests** — from request wide events when the
+  input carries them (reqlog files, flight dumps), else from
+  ``serve/request``-class spans whose args carry ``req_id`` — with
+  window / rows / serve bucket / model generation where known;
+- for flight dumps: the trigger history and the dump's reason line.
+
+Standalone: ``python tools/trace_summary.py FILE [--top N]``
+(exit 0 ok / 2 unreadable-or-unrecognized). Importable — the unit
+tests drive ``load_artifact``/``span_table``/``top_requests``/
+``render`` directly.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Tuple
+
+# span names that represent one serving request dispatch (the spans
+# fallback for top-N when no request wide events are present)
+REQUEST_SPAN_NAMES = ("serve/request", "predict/stacked")
+
+
+def load_artifact(path: str) -> Tuple[str, dict]:
+    """Sniff and load one artifact -> (kind, normalized doc) where
+    kind is "trace" | "flight" | "reqlog" and doc always carries
+    ``events`` (span/instant dicts) and ``records`` (wide events).
+    Raises ValueError for unrecognized content."""
+    with open(path) as fh:
+        doc = None
+        try:
+            doc = json.load(fh)
+        except json.JSONDecodeError:
+            fh.seek(0)          # not ONE document: try JSONL below
+        if doc is not None:
+            if isinstance(doc, dict) and "traceEvents" in doc:
+                return "trace", {"events": doc["traceEvents"],
+                                 "records": [],
+                                 "meta": doc.get("otherData", {})}
+            if (isinstance(doc, dict)
+                    and doc.get("schema") == "lightgbm-tpu/flight"):
+                return "flight", {"events": doc.get("spans", []),
+                                  "records": doc.get("reqlog", []),
+                                  "meta": {
+                                      "reason": doc.get("reason"),
+                                      "context": doc.get("context"),
+                                      "created_unix": doc.get(
+                                          "created_unix"),
+                                      "triggers": doc.get("triggers",
+                                                          []),
+                                      "log_lines": doc.get("log_lines",
+                                                           [])}}
+            raise ValueError(f"{path}: JSON but neither a trace "
+                             f"(traceEvents) nor a flight dump "
+                             f"(schema=lightgbm-tpu/flight)")
+        # JSONL: a request log (one wide event per line, optional
+        # header record) — skip unparseable lines like lrb.py's
+        # trace reader does
+        records = []
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") != "header":
+                records.append(rec)
+        if not records:
+            raise ValueError(f"{path}: no recognizable records "
+                             f"(want trace JSON, a flight dump, or "
+                             f"reqlog JSONL)")
+        return "reqlog", {"events": [], "records": records, "meta": {}}
+
+
+def span_table(events: List[dict]) -> List[dict]:
+    """Aggregate complete-events per (thread, span name) -> rows
+    sorted by total duration desc. Thread names come from the ph:"M"
+    thread_name metadata when present, else the numeric tid."""
+    names = {}
+    for ev in events:
+        if (ev.get("ph") == "M" and ev.get("name") == "thread_name"
+                and isinstance(ev.get("args"), dict)):
+            names[ev.get("tid")] = ev["args"].get("name")
+    agg = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        tid = ev.get("tid")
+        key = (tid, ev.get("name"))
+        row = agg.get(key)
+        dur_ms = float(ev.get("dur", 0.0)) / 1000.0
+        if row is None:
+            agg[key] = {"thread": names.get(tid) or f"tid {tid}",
+                        "span": ev.get("name"), "count": 1,
+                        "total_ms": dur_ms, "max_ms": dur_ms}
+        else:
+            row["count"] += 1
+            row["total_ms"] += dur_ms
+            row["max_ms"] = max(row["max_ms"], dur_ms)
+    rows = sorted(agg.values(), key=lambda r: -r["total_ms"])
+    for r in rows:
+        r["mean_ms"] = r["total_ms"] / r["count"]
+    return rows
+
+
+def top_requests(doc: dict, n: int = 10) -> List[dict]:
+    """The N slowest requests: from request wide events when present
+    (latency_ms, plus window/rows/bucket/model identity), else from
+    request-class spans carrying args.req_id (dur -> latency)."""
+    recs = [r for r in doc.get("records", [])
+            if r.get("kind") == "request"
+            and isinstance(r.get("latency_ms"), (int, float))]
+    if recs:
+        rows = [{k: r.get(k) for k in
+                 ("req_id", "latency_ms", "path", "window", "rows",
+                  "serve_bucket", "model_window", "staleness_windows")
+                 if r.get(k) is not None} for r in recs]
+        return sorted(rows, key=lambda r: -r["latency_ms"])[:n]
+    rows = []
+    for ev in doc.get("events", []):
+        args = ev.get("args")
+        if (ev.get("ph") == "X" and isinstance(args, dict)
+                and "req_id" in args
+                and ev.get("name") in REQUEST_SPAN_NAMES):
+            row = {"req_id": args["req_id"],
+                   "latency_ms": round(float(ev.get("dur", 0.0))
+                                       / 1000.0, 3)}
+            for k in ("window", "rows"):
+                if k in args:
+                    row[k] = args[k]
+            rows.append(row)
+    return sorted(rows, key=lambda r: -r["latency_ms"])[:n]
+
+
+def _fmt_table(rows: List[dict], columns: List[Tuple[str, str]]) -> str:
+    """Plain aligned text table: columns = [(key, heading)]."""
+    def cell(r, k):
+        v = r.get(k)
+        if isinstance(v, float):
+            return f"{v:.3f}"
+        return "" if v is None else str(v)
+
+    widths = [max(len(h), *(len(cell(r, k)) for r in rows))
+              if rows else len(h) for k, h in columns]
+    out = ["  ".join(h.ljust(w) for (_, h), w in zip(columns, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        out.append("  ".join(cell(r, k).ljust(w)
+                             for (k, _), w in zip(columns, widths)))
+    return "\n".join(out)
+
+
+def render(kind: str, doc: dict, top: int = 10) -> str:
+    """The full human rendering of one loaded artifact."""
+    parts = []
+    meta = doc.get("meta", {})
+    if kind == "flight":
+        parts.append(f"flight dump: reason={meta.get('reason')} "
+                     f"context={json.dumps(meta.get('context', {}))}")
+        trigs = meta.get("triggers", [])
+        if trigs:
+            parts.append("triggers:")
+            for t in trigs[-top:]:
+                parts.append(f"  ts={t.get('ts')} {t.get('reason')}"
+                             + (f" {json.dumps(t['context'])}"
+                                if t.get("context") else ""))
+        parts.append("")
+    elif kind == "trace" and meta.get("dropped_events"):
+        parts.append(f"(ring dropped {meta['dropped_events']} older "
+                     f"events)")
+        parts.append("")
+    spans = span_table(doc.get("events", []))
+    if spans:
+        parts.append(f"per-thread span table ({len(spans)} rows, "
+                     f"hottest first):")
+        parts.append(_fmt_table(spans, [
+            ("thread", "thread"), ("span", "span"),
+            ("count", "count"), ("total_ms", "total_ms"),
+            ("mean_ms", "mean_ms"), ("max_ms", "max_ms")]))
+        parts.append("")
+    reqs = top_requests(doc, top)
+    if reqs:
+        parts.append(f"top {len(reqs)} slow requests:")
+        parts.append(_fmt_table(reqs, [
+            ("req_id", "req_id"), ("latency_ms", "latency_ms"),
+            ("path", "path"), ("window", "window"), ("rows", "rows"),
+            ("serve_bucket", "bucket"),
+            ("model_window", "model_win"),
+            ("staleness_windows", "stale")]))
+        parts.append("")
+    windows = [r for r in doc.get("records", [])
+               if r.get("kind") in ("window", "degraded_window")]
+    if windows:
+        parts.append(f"window records ({len(windows)}):")
+        parts.append(_fmt_table(windows[-top:], [
+            ("window", "window"), ("kind", "kind"),
+            ("train_s", "train_s"), ("window_wall_s", "wall_s"),
+            ("fp_rate", "fp"), ("fn_rate", "fn"),
+            ("degrade_label", "degrade"),
+            ("staleness_windows", "stale")]))
+        parts.append("")
+    if not spans and not reqs and not windows:
+        parts.append("(no spans, requests or windows in this artifact)")
+    return "\n".join(parts).rstrip() + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a trace / flight dump / request log: "
+                    "per-thread span table + top-N slow requests.")
+    ap.add_argument("path", help="trace JSON (tpu_trace), flight dump "
+                                 "(flight_*.json) or reqlog JSONL "
+                                 "(tpu_reqlog) — format is sniffed")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slow requests / tail rows shown (default 10)")
+    args = ap.parse_args(argv)
+    try:
+        kind, doc = load_artifact(args.path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"cannot summarize {args.path}: {e}", file=sys.stderr)
+        return 2
+    print(f"# {args.path}: {kind} artifact")
+    print(render(kind, doc, top=max(args.top, 1)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
