@@ -1,0 +1,24 @@
+"""Repo-native static analysis (stdlib-only, no jax import).
+
+Purpose-built checkers for THIS codebase's invariants, not a general
+linter:
+
+- ``jit_capture``   — functions handed to ``jax.jit`` or registered in
+  the process-wide step/predict registries must close only over
+  provably-static kinds (the PR-5 closure-recapture and PR-7
+  captured-device-array bug classes, caught at analysis time).
+- ``lock_discipline`` — ``# guarded-by: <lock>`` annotated attributes
+  must only be written lexically inside a matching ``with`` block.
+- ``contracts``     — ``tpu_*`` knob declaration/validation/docs/
+  VOLATILE_KNOBS classification, obs metric naming + bounded label
+  cardinality, atomic artifact writes in obs/utils/tools.
+- ``lockorder``     — the one DYNAMIC companion: an opt-in
+  instrumentation wrapper over the repo's named locks that records
+  the acquisition-order graph during the thread-hammer tests and
+  fails on cycles.
+
+Driver: ``python tools/run_analysis.py`` (baseline file, ``--json``,
+exit 0/1/2). This package deliberately imports nothing heavy at
+module scope — ``lockorder`` is imported by production modules at
+lock-creation time and must stay effectively free.
+"""

@@ -685,6 +685,40 @@ class Config:
                         "serial/feature/data/voting); using 'serial'",
                         self.tree_learner)
             self.tree_learner = "serial"
+        if self.tpu_count_proxy not in (-1, 0, 1):
+            log.warning("tpu_count_proxy=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_count_proxy)
+            self.tpu_count_proxy = -1
+        if self.tpu_packed_bins not in (-1, 0, 1):
+            log.warning("tpu_packed_bins=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_packed_bins)
+            self.tpu_packed_bins = -1
+        if self.tpu_hist_chunk < 0:
+            log.warning("tpu_hist_chunk=%d is negative; using 0 "
+                        "(auto)", self.tpu_hist_chunk)
+            self.tpu_hist_chunk = 0
+        if self.tpu_wave_size < 0:
+            # the grower clamps the UPPER side against the active lane
+            # cap (models/gbdt.py); a negative would flow through
+            # ``tpu_wave_size or w_cap`` as a bogus wave width
+            log.warning("tpu_wave_size=%d is negative; using 0 "
+                        "(auto)", self.tpu_wave_size)
+            self.tpu_wave_size = 0
+        if self.tpu_stop_check_interval < 1:
+            log.warning("tpu_stop_check_interval=%d is below the "
+                        "floor; using 1 (check every iteration)",
+                        self.tpu_stop_check_interval)
+            self.tpu_stop_check_interval = 1
+        if self.tpu_dispatch_sync_interval < 0:
+            log.warning("tpu_dispatch_sync_interval=%d is negative; "
+                        "using 0 (unbounded dispatch queue)",
+                        self.tpu_dispatch_sync_interval)
+            self.tpu_dispatch_sync_interval = 0
+        if self.tpu_ingest_chunk_rows < 0:
+            log.warning("tpu_ingest_chunk_rows=%d is negative; using "
+                        "0 (auto-sized chunks)",
+                        self.tpu_ingest_chunk_rows)
+            self.tpu_ingest_chunk_rows = 0
         if self.tpu_quantized_psum not in (-1, 0, 1):
             log.warning("tpu_quantized_psum=%d is not one of -1/0/1; "
                         "using -1 (auto)", self.tpu_quantized_psum)

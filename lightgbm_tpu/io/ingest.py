@@ -70,6 +70,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ..analysis import lockorder
 from ..obs import registry as obs
 from ..obs import trace
 from ..utils import log, timing
@@ -245,8 +246,9 @@ class ChunkRing:
     """
 
     def __init__(self, capacity: int = 8):
-        self._lock = threading.Lock()
+        self._lock = lockorder.named_lock("ingest.chunk_ring._lock")
         self._cap = max(int(capacity), 1)
+        # guarded-by: _lock
         self._slots: "collections.OrderedDict[int, tuple]" = \
             collections.OrderedDict()
 
@@ -482,6 +484,12 @@ class DeviceBinner:
                       else jnp.concatenate(parts, axis=0))
             return jnp.take(allout, inv_perm, axis=0).astype(out_dtype)
 
+        # jit-capture: ok(Fn, f32_input, out_dtype, nan_bin, cats,
+        # cat_nbin, inv_perm, key32_dev, lower_bound) —
+        # per-binner jit: the captured mapper tables ARE the kernel's
+        # constants, derived from THIS dataset's bin mappers and
+        # cached on the binner instance (one binner per dataset,
+        # asserted by create_valid's mapper-reuse contract).
         return jax.jit(chunk)
 
     # -- host-side chunk prep ------------------------------------------------
@@ -681,6 +689,7 @@ class DeviceBinner:
                 return full
 
             outs.append(self._submit(prepped, assemble=assemble))
+            # bounded-cardinality: two literal names (hit/miss)
             obs.counter("ingest/ring_hits"
                         if resident is not None
                         else "ingest/ring_misses").add(1)
@@ -923,6 +932,10 @@ class SparseDeviceBinner(DeviceBinner):
             rows = jnp.concatenate([nr, cr]) + r0
             return out, codes, feat, rows
 
+        # jit-capture: ok(C, zb, nan_bin, out_dtype,
+        # lower_bound_entries) — per-binner jit (see the dense
+        # DeviceBinner note above): zero-bin/nan tables are this
+        # dataset's mapper constants, cached on the binner instance.
         return jax.jit(chunk)
 
     # -- host-side chunk prep ------------------------------------------------

@@ -56,6 +56,7 @@ from .obs import reqlog
 from .obs import slo as obs_slo
 from .obs import trace
 from .utils import faults, log, retry
+from .analysis import lockorder
 
 HISTFEATURES = 50            # test.cpp:16
 NUM_FEATURES = HISTFEATURES + 3
@@ -198,14 +199,14 @@ class LrbDriver:
         # flight, atomic publish under the swap lock
         self.pipelined = int(self.params.get("tpu_lrb_pipeline",
                                              -1)) != 0
-        self._swap_lock = threading.Lock()
+        self._swap_lock = lockorder.named_lock("lrb._swap_lock")
         # serializes the pending-window takeover: results/booster
         # drain from any thread, and two concurrent drains must not
         # both run the join body (double-counted staleness, duplicate
         # result lines)
-        self._join_lock = threading.Lock()
-        self._serving = None          # published booster handle
-        self._pending: Optional[dict] = None
+        self._join_lock = lockorder.named_lock("lrb._join_lock")
+        self._serving = None          # guarded-by: _swap_lock
+        self._pending: Optional[dict] = None   # guarded-by: _join_lock
         self._executor: Optional[
             concurrent.futures.ThreadPoolExecutor] = None
         self._eval_executor: Optional[
@@ -420,17 +421,31 @@ class LrbDriver:
                 h = self._serving       # swap-at-boundary snapshot
             rec["train_rows"] = len(labels)
             rec.update(self._opt_ratios())
-            self._submit_train(labels, X, rec, t_window)
-            if h is not None and ev is not None:
-                # the evaluation — the expensive serving loop — runs
-                # on its own server thread, concurrent with BOTH this
-                # window's training and the next window's arrivals;
-                # the join-time snapshot pins the model, so the
-                # result is exactly the sequential loop's
-                self._pending["eval"] = self._submit_eval(
-                    ev, h, ev_derive_s, wi)
-        if self._pending is not None:
-            self._pending["boundary_end"] = time.monotonic()
+            # build the COMPLETE pending record — training future AND
+            # eval future — before publishing it: a drain() racing in
+            # from another thread (the results/booster properties)
+            # between a train-only publish and a later eval attach
+            # would join the window without its evaluation and the
+            # record would silently lose its fp/fn/serve fields
+            pending = self._submit_train(labels, X, rec, t_window)
+            try:
+                if h is not None and ev is not None:
+                    # the evaluation — the expensive serving loop —
+                    # runs on its own server thread, concurrent with
+                    # BOTH this window's training and the next
+                    # window's arrivals; the join-time snapshot pins
+                    # the model, so the result is exactly the
+                    # sequential loop's
+                    pending["eval"] = self._submit_eval(
+                        ev, h, ev_derive_s, wi)
+            finally:
+                # publish even if the eval submit failed — the
+                # trainer future must stay joinable
+                with self._join_lock:
+                    self._pending = pending
+        with self._join_lock:
+            if self._pending is not None:
+                self._pending["boundary_end"] = time.monotonic()
         self._results.append(rec)
 
     # -- OPT labeling (test.cpp:97-121) --------------------------------------
@@ -721,6 +736,10 @@ class LrbDriver:
             # dump captures the failing window's spans/requests NOW
             label = _degrade_label(reason)
             rec["degrade_label"] = label
+            # bounded-cardinality: label comes from _degrade_label's
+            # closed set (budget/injected_fault[_transient]/
+            # degenerate_labels) plus exception CLASS names — bounded
+            # by the code, not by request data
             obs.counter(f"lrb/degraded_reason/{label}").add(1)
             reqlog.record(
                 "degraded_window", window=rec["window"], label=label,
@@ -738,16 +757,21 @@ class LrbDriver:
     # -- the trainer-thread pipeline -----------------------------------------
 
     def _submit_train(self, labels: np.ndarray, X: np.ndarray,
-                      rec: dict, t_window: float) -> None:
+                      rec: dict, t_window: float) -> dict:
+        """Hand one window's training to the trainer thread and
+        return the UNPUBLISHED pending record — the boundary attaches
+        the eval future and then publishes the complete record to
+        ``self._pending`` in one locked write (see
+        _process_window_pipelined)."""
         if self._executor is None:
             self._executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="lrb-trainer")
         self._train_started.clear()
         fut = self._executor.submit(self._train_async, labels, X,
                                     self.window_index)
-        self._pending = {"window": self.window_index, "future": fut,
-                         "rec": rec, "t_window": t_window,
-                         "submit_t": time.monotonic()}
+        return {"window": self.window_index, "future": fut,
+                "rec": rec, "t_window": t_window,
+                "submit_t": time.monotonic()}
 
     def _submit_eval(self, ev, handle, ev_derive_s: float, wi: dict):
         """Queue one window's evaluation on the server thread (single
@@ -817,6 +841,8 @@ class LrbDriver:
         with self._join_lock:
             self._join_pending_locked()
 
+    # guarded-by: _join_lock (called only from _join_pending's
+    # locked region — the checker verifies every call site)
     def _join_pending_locked(self) -> None:
         p = self._pending
         if p is None:

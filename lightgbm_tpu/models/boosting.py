@@ -337,6 +337,11 @@ class RF(GBDT):
                 recs.append(rec)
             return scores, tuple(vs), recs
 
+        # jit-capture: ok(K, n, pad_rows, grower, renew, renew_label,
+        # renew_w) — RF's averaging step is step-cache-INELIGIBLE by
+        # design (CHANGES.md PR 5): this jit is per-booster, cached on
+        # self._step_fn, and the captured aux arrays are this
+        # booster's own — never registry-shared.
         self._step_fn = jax.jit(step, donate_argnums=(1, 2))
         self._step_key = key_id
         return self._step_fn

@@ -47,6 +47,7 @@ from ..ops.predict import add_leaf_outputs, replay_partition
 from ..ops.split import SplitParams
 from ..ops.wave_grower import WaveGrowerConfig
 from ..utils import log, timing
+from ..analysis import lockorder
 from .tree import Tree, tree_from_record
 
 K_MODEL_VERSION = "v2"     # gbdt.h kModelVersion
@@ -95,9 +96,11 @@ class GBDT:
         # and the lock that keeps a predict() racing a retrain from
         # ever seeing a half-built predictor (RLock: _bump_model_gen
         # runs under it from paths _stacked_model may itself trigger)
-        self._stacked_lock = threading.RLock()
-        self._stacked_cache = None
-        self._stacked_ref: Optional[List] = None
+        self._stacked_lock = lockorder.named_rlock(
+            "gbdt._stacked_lock")
+        self._stacked_cache = None        # guarded-by: _stacked_guard()
+        self._stacked_ref: Optional[List] = None  # guarded-by: _stacked_guard()
+        self._model_gen = 0               # guarded-by: _stacked_guard()
 
     # -- init (gbdt.cpp:47-117) --------------------------------------------
 
@@ -1554,7 +1557,8 @@ class GBDT:
         deserialized around __init__ (copy/pickle shims)."""
         lk = getattr(self, "_stacked_lock", None)
         if lk is None:
-            lk = self._stacked_lock = threading.RLock()
+            lk = self._stacked_lock = lockorder.named_rlock(
+                "gbdt._stacked_lock")
         return lk
 
     def _bump_model_gen(self) -> None:
@@ -1722,6 +1726,10 @@ class GBDT:
             builders = [m.device_eval_builder(self.objective)
                         for m in metrics]
             if all(b is not None for b in builders):
+                # jit-capture: ok(builders) — per-booster jit cached
+                # on self._dev_eval_fns keyed by dataset; the metric
+                # builders close over THIS booster's eval arrays,
+                # never registry-shared
                 fn = jax.jit(
                     lambda s: jnp.stack([b(s) for b in builders]))
         cache[data_idx] = fn

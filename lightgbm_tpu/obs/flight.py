@@ -51,6 +51,7 @@ import time
 from collections import deque
 from typing import List, Optional
 
+from ..analysis import lockorder
 from ..utils import log
 from ..utils.fileio import atomic_write
 from . import trace as _trace
@@ -92,7 +93,7 @@ class FlightRecorder:
         # REENTRANT: the SIGTERM handler runs trigger() on whatever
         # the main thread was doing — including mid-trigger with this
         # lock held; a plain Lock would deadlock the dying process
-        self._lock = threading.RLock()
+        self._lock = lockorder.named_rlock("obs.flight._lock")
         self._spans: deque = deque(maxlen=self.capacity)
         self._logs: deque = deque(maxlen=self.capacity)
         self._metric_snaps: deque = deque(maxlen=METRIC_SNAPS_KEPT)

@@ -43,6 +43,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from ..analysis import lockorder
 from ..utils import log, timing
 from . import trace
 from .registry import MetricsRegistry, default_registry
@@ -82,7 +83,7 @@ class RunRecorder:
         self.watchdog_factor = float(watchdog_factor or 0.0)
         self.meta = dict(meta or {})
         self._reg = registry or default_registry()
-        self._lock = threading.Lock()
+        self._lock = lockorder.named_lock("obs.recorder._lock")
         self._by_it: Dict[int, dict] = {}
         # per-kind trailing windows ("iter" vs "sync" spans must not
         # be judged against each other's medians)

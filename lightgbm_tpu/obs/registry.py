@@ -26,6 +26,8 @@ import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis import lockorder
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "Timer", "MetricsRegistry",
     "default_registry", "counter", "gauge", "histogram", "timer",
@@ -293,11 +295,11 @@ class MetricsRegistry:
     """Named instruments in four domains, one lock, atomic snapshot."""
 
     def __init__(self):
-        self._lock = threading.RLock()
-        self._counters: "OrderedDict[str, Counter]" = OrderedDict()
-        self._gauges: "OrderedDict[str, Gauge]" = OrderedDict()
-        self._histograms: "OrderedDict[str, Histogram]" = OrderedDict()
-        self._timers: "OrderedDict[str, Timer]" = OrderedDict()
+        self._lock = lockorder.named_rlock("obs.registry._lock")
+        self._counters: "OrderedDict[str, Counter]" = OrderedDict()   # guarded-by: _lock
+        self._gauges: "OrderedDict[str, Gauge]" = OrderedDict()       # guarded-by: _lock
+        self._histograms: "OrderedDict[str, Histogram]" = OrderedDict()  # guarded-by: _lock
+        self._timers: "OrderedDict[str, Timer]" = OrderedDict()       # guarded-by: _lock
 
     # -- get-or-create accessors --------------------------------------------
 

@@ -44,6 +44,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Optional
 
+from ..analysis import lockorder
 from .trace import config_get
 
 __all__ = [
@@ -146,7 +147,7 @@ class RequestLog:
         self.sample = min(max(float(sample), 0.0), 1.0)
         self._threshold = int(self.sample * 4294967296.0)
         self._ring: deque = deque(maxlen=max(int(ring_records), 16))
-        self._lock = threading.Lock()
+        self._lock = lockorder.named_lock("obs.reqlog._lock")
         self._fh = None
         self._write_warned = False
         if registry is None:

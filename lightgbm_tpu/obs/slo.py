@@ -56,6 +56,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ..analysis import lockorder
 from .registry import MetricsRegistry, default_registry
 from .trace import config_get
 
@@ -232,7 +233,7 @@ class SloEngine:
                  registry: Optional[MetricsRegistry] = None):
         self.specs = list(specs)
         self._reg = registry or default_registry()
-        self._lock = threading.Lock()
+        self._lock = lockorder.named_lock("obs.slo._lock")
         # per-spec accounting: cumulative (total, bad) at the last
         # evaluation (burn deltas), tick counts for gauge specs, and
         # the exhaustion latch (one flight trigger per spec)
@@ -251,6 +252,9 @@ class SloEngine:
 
     # -- per-spec reads ------------------------------------------------------
 
+    # bounded-cardinality: every dynamic metric name in this method
+    # is a source from the parsed tpu_slo spec list (validated at
+    # config time) — one series per configured objective
     def _events(self, spec: SloSpec):
         """-> (current, total_events, bad_events) for one spec; current
         is in the spec's display unit."""
@@ -297,6 +301,9 @@ class SloEngine:
                         "report", e)
             return self._last_report or {"specs": [], "ok": None}
 
+    # bounded-cardinality: the slo/<name>/* gauge family is one
+    # series-set per configured objective (tpu_slo is a validated,
+    # finite spec list)
     def _evaluate(self) -> dict:
         with self._lock:
             self._evaluations += 1

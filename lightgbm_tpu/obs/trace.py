@@ -56,6 +56,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Optional
 
+from ..analysis import lockorder
 from ..utils.fileio import atomic_write
 
 __all__ = [
@@ -143,7 +144,7 @@ class Tracer:
     def __init__(self, path: str, capacity: int = DEFAULT_BUFFER_EVENTS):
         self.path = str(path)
         self.capacity = max(int(capacity), MIN_BUFFER_EVENTS)
-        self._lock = threading.Lock()
+        self._lock = lockorder.named_lock("obs.trace._lock")
         self._events: deque = deque(maxlen=self.capacity)
         self._threads: dict = {}        # tid -> thread name
         self._dropped = 0

@@ -621,6 +621,8 @@ def _psum_measure_fn(mesh, shape):
     def build(dtype):
         def body(x):
             return jax.lax.psum(x, AXIS)
+        # jit-capture: ok(*) — throwaway psum microbenchmark body,
+        # closes over nothing but the mesh axis; never cached
         f = jax.jit(_shard_map(body, mesh=mesh, in_specs=(P(),),
                                out_specs=P(), check_vma=False))
         x = jnp.ones(shape, dtype)

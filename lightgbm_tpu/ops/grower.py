@@ -425,4 +425,10 @@ def make_tree_grower(cfg: GrowerConfig, meta: FeatureMeta,
         )
         return rec, state.leaf_ids
 
+    # jit-capture: ok(B, hp, meta, col_fn, hist_fn, reduce_fn,
+    # split_fn, _store_split, depth_ok) — factory-scoped jit: every
+    # capture derives from THIS factory call's cfg/meta/seam
+    # callables, and callers cache per (booster, geometry); the
+    # shared-step registry reaches this grower only through
+    # build_train_step, whose geometry key covers cfg and meta.
     return jax.jit(grow) if jit else grow

@@ -64,7 +64,7 @@ SERVE_MIN_BUCKET = 16
 _POW2_CAP = 1 << 14
 
 _lock = threading.Lock()
-_entries: "OrderedDict[tuple, Callable]" = OrderedDict()
+_entries: "OrderedDict[tuple, Callable]" = OrderedDict()  # guarded-by: _lock
 _mode = -1          # config.tpu_predict_cache  (-1 auto / 0 off / 1 on)
 _bucket = -1        # config.tpu_serve_bucket   (-1 pow2 / 0 exact / N)
 

@@ -513,6 +513,8 @@ class StackedModel:
         call traffic."""
         fn = self._dispatch_memo.get(key)
         if fn is None:
+            # jit-capture: ok(builder) — forwarding seam: the real
+            # builders are audited at their _dispatch call sites
             fn = predict_cache.get(key, builder)
             self._dispatch_memo[key] = fn
         return fn
@@ -710,6 +712,8 @@ class StackedModel:
         if dev_bin:     # upload the edge tables once, not per chunk
             aux = (jnp.asarray(self._E_f32), jnp.asarray(self._off32),
                    jnp.asarray(self._nan_slot))
+        # jit-capture: ok(Wtot) — determined by offs (the per-feature
+        # table offsets sum to Wtot), which IS in the key
         fn = self._dispatch(key, build)
         handles = self._stream(rows, N, bucket, lambda p: p,
                                lambda p: fn(p, dev, aux))

@@ -72,7 +72,7 @@ MAX_ENTRIES = 64
 MIN_BUCKET = 256
 
 _lock = threading.Lock()
-_steps: "OrderedDict[tuple, Callable]" = OrderedDict()
+_steps: "OrderedDict[tuple, Callable]" = OrderedDict()  # guarded-by: _lock
 _mode = -1          # config.tpu_step_cache   (-1 auto / 0 off / 1 on)
 _bucket = -1        # config.tpu_row_bucket   (-1 pow2 / 0 exact / N)
 
@@ -375,4 +375,9 @@ def build_train_step(*, grower, K: int, n_score: int, n_total: int,
             recs.append(rec)
         return scores, tuple(vs), recs
 
+    # jit-capture: ok(grower, grad_fn, sample_hook) — the three
+    # callable seams. Registry-path callers pass callables that close
+    # only over config scalars/statics, all covered by the geometry
+    # key (obj.static_key(), _grower_cfg, learner mode); legacy
+    # callers jit per booster, so a capture is that booster's own.
     return jax.jit(step, donate_argnums=(1, 2))

@@ -928,6 +928,15 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         )
         return rec, state.leaf_ids
 
+    # jit-capture: ok(B, hp, cfg, quant, use_fused, meta_const,
+    # bound_counts, depth_ok, hist_fn, hist_reduce_fn, reduce_fn,
+    # max_reduce_fn, row_offset_fn, split_fn, partition_fn) —
+    # factory-scoped jit: every capture derives from this factory
+    # call's WaveGrowerConfig/meta/seam callables. meta_const is the
+    # LEGACY 5-arg fallback only; registry-path callers pass meta as
+    # the traced 6th argument (PR 5), and the step-cache geometry key
+    # covers cfg + the meta signature, so a registry hit can never
+    # see another booster's meta_const.
     return jax.jit(grow) if jit else grow
 
 

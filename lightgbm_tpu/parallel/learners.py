@@ -211,6 +211,9 @@ def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                   meta_specs),
         out_specs=(P(), P(AXIS)),
         check_vma=False)
+    # jit-capture: ok(sharded) — shard_map-wrapped grower: the grow
+    # factory's own jit site carries the capture audit (meta rides as
+    # a replicated ARGUMENT, PR 4), and this jit is factory-scoped.
     jitted = jax.jit(sharded)
 
     def call(bins_t, g, h, mask, fmask, meta=None):
@@ -265,6 +268,9 @@ def make_feature_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         in_specs=(P(None, None), P(None), P(None), P(None), P(None)),
         out_specs=(P(), P()),
         check_vma=False)
+    # jit-capture: ok(sharded) — shard_map-wrapped grower: the grow
+    # factory's own jit site carries the capture audit (meta rides as
+    # a replicated ARGUMENT, PR 4), and this jit is factory-scoped.
     return jax.jit(sharded)
 
 
@@ -319,6 +325,9 @@ def make_feature_parallel_bundled_grower(cfg: WaveGrowerConfig,
         in_specs=(P(None, None), P(None), P(None), P(None), P(None)),
         out_specs=(P(), P()),
         check_vma=False)
+    # jit-capture: ok(sharded) — shard_map-wrapped grower: the grow
+    # factory's own jit site carries the capture audit (meta rides as
+    # a replicated ARGUMENT, PR 4), and this jit is factory-scoped.
     return jax.jit(sharded)
 
 
@@ -418,6 +427,9 @@ def make_voting_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS), P(None)),
         out_specs=(P(), P(AXIS)),
         check_vma=False)
+    # jit-capture: ok(sharded) — shard_map-wrapped grower: the grow
+    # factory's own jit site carries the capture audit (meta rides as
+    # a replicated ARGUMENT, PR 4), and this jit is factory-scoped.
     return jax.jit(sharded)
 
 

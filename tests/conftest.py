@@ -117,6 +117,22 @@ def _step_cache_suite_guard():
             "(every booster is re-compiling its fused step)" % s)
 
 
+@pytest.fixture
+def lock_order():
+    """Run a thread-hammer test with the runtime lock-order detector
+    armed (lightgbm_tpu/analysis/lockorder.py): locks created inside
+    the test via the named-lock factories are tracked, the known
+    module-level locks are swapped for the window, and the test fails
+    if the recorded acquisition graph has a cycle — "no deadlock yet"
+    becomes a checked property of exactly the interleavings the
+    hammer generates. Production pays nothing: detection is off
+    everywhere else."""
+    from lightgbm_tpu.analysis import lockorder
+    with lockorder.detecting() as mon:
+        yield mon
+    mon.assert_acyclic()
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,37 @@
+"""PR-5 closure-recapture bug, in miniature (DO NOT FIX — this file
+is a regression fixture for the jit-capture checker).
+
+The historical shape: the fused training step was a pure function of
+a geometry key, but a refactor silently re-captured per-booster state
+— here the label array — into the function registered process-wide.
+Two boosters with the same geometry then share ONE compiled program
+with the FIRST booster's labels baked in as a trace constant: the
+second booster trains on the wrong data, bit-exactly wrong, and the
+only runtime symptom is the conftest hit-rate assertion this checker
+replaces as the sole defense.
+
+tests/test_analysis.py asserts the jit-capture checker FLAGS the
+``labels`` capture below (and that the _fixed twin passes).
+"""
+import jax
+import numpy as np
+
+from lightgbm_tpu.ops import step_cache
+
+
+def make_step(self, y, num_leaves: int):
+    labels = np.asarray(y, np.float32)   # per-booster array
+    n = int(y.shape[0])
+
+    def builder():
+        def step(bins, scores):
+            # BUG: ``labels`` is a closure capture — it bakes into the
+            # shared compiled program as a constant; a registry hit
+            # from a same-geometry booster serves THESE labels
+            grad = scores - labels
+            return bins, scores - 0.1 * grad
+
+        return jax.jit(step)
+
+    key = ("mini_step", n, num_leaves)
+    return step_cache.get_step(key, builder)

@@ -1,0 +1,23 @@
+"""Fixed form of the PR-5 closure-recapture miniature: the label
+array rides as a TRACED ARGUMENT (the aux-pytree seam of
+objectives/objective.py), so the registered program is pure in its
+geometry key and any booster's call supplies its own arrays. The
+jit-capture checker must pass this file clean."""
+import jax
+
+from lightgbm_tpu.ops import step_cache
+
+
+def make_step(self, y, num_leaves: int):
+    n = int(y.shape[0])
+
+    def builder():
+        def step(bins, scores, labels):
+            # labels is an argument: each caller binds its own array
+            grad = scores - labels
+            return bins, scores - 0.1 * grad
+
+        return jax.jit(step)
+
+    key = ("mini_step", n, num_leaves)
+    return step_cache.get_step(key, builder)

@@ -1,0 +1,42 @@
+"""PR-7 captured-device-array bug, in miniature (DO NOT FIX — this
+file is a regression fixture for the jit-capture checker).
+
+The historical shape: a predict-registry wrapper closed over the
+first model's device stacks. The registry key covered the GEOMETRY
+(shapes, offsets, class count), so a retrained same-geometry model
+hit the warm entry — and the warm program served the FIRST model's
+arrays. Caught back then by the serving parity suite after the fact;
+the jit-capture checker flags it at analysis time.
+
+tests/test_analysis.py asserts the checker FLAGS the ``dev``/``aux``
+captures below (and that the _fixed twin passes).
+"""
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops import predict_cache
+
+
+def _forest_eval(part, W, P, aux):
+    return jnp.einsum("rs,wsl->rl", part, W)[:, :1] + P[0, 0, 0]
+
+
+class MiniStacked:
+    def predict(self, rows, S: int, L: int, K: int):
+        dev = self._device_arrays()          # THIS model's stacks
+        aux = (jnp.asarray(self._edges),)
+
+        def build():
+            def run(part):
+                # BUG: dev/aux are closure captures — a registry hit
+                # from a retrained same-geometry model runs the warm
+                # program on the FIRST model's device arrays
+                return _forest_eval(part, dev[0], dev[1], aux)
+
+            return run
+
+        key = ("mini_predict", S, L, K)
+        fn = predict_cache.get(key, build)
+        return fn(rows)
+
+    def _device_arrays(self):
+        return (jnp.zeros((2, 4, 4)), jnp.zeros((1, 1, 1)))

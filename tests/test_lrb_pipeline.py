@@ -206,9 +206,11 @@ def test_pipelined_beats_sequential_wall_at_rate():
 
 # -- serving-during-retrain liveness -----------------------------------------
 
-def test_serving_stays_live_during_retrain():
+def test_serving_stays_live_during_retrain(lock_order):
     """predict_live returns while the trainer thread provably holds a
-    window (parked on the test gate), serving the previous model."""
+    window (parked on the test gate), serving the previous model.
+    Runs under the lock-order detector: the swap/join/serving-lock
+    acquisition graph of a mid-window serve must stay acyclic."""
     reqs = list(lrb.synthetic_trace(600, 60))
     drv = _driver(1)
     for r in reqs[:300]:

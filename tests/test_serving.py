@@ -242,10 +242,12 @@ def test_set_leaf_value_invalidates_stacked():
 
 # -- thread safety: predict while retraining ---------------------------------
 
-def test_predict_during_training_is_safe():
+def test_predict_during_training_is_safe(lock_order):
     """Concurrent predict() calls while the booster trains more trees:
     no crash, no half-built predictor, every result equals a clean
-    predict at SOME consistent tree count (prefix snapshots)."""
+    predict at SOME consistent tree count (prefix snapshots). Runs
+    under the lock-order detector (conftest.lock_order): the serving
+    lock vs registry/obs lock acquisition graph must stay acyclic."""
     X, y = make_binary(n=1200, f=6, seed=31)
     g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
                  num_round=8)

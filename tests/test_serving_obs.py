@@ -316,10 +316,13 @@ def test_exporter_snapshot_age_gauge_and_slo_evaluation(tmp_path):
         ex.stop()
 
 
-def test_concurrent_scrape_hammer_during_live_lrb_run(tmp_path):
+def test_concurrent_scrape_hammer_during_live_lrb_run(tmp_path,
+                                                       lock_order):
     """N threads hammer /metrics, /metrics.json, /healthz and /slo
     while a real (pipelined) LRB loop trains/serves — every response
-    must be 200 and parseable; no torn bodies, no 500s."""
+    must be 200 and parseable; no torn bodies, no 500s. Runs under
+    the lock-order detector: exporter/slo/registry/driver locks must
+    record an acyclic acquisition graph."""
     import io
     import urllib.request
 

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Run the repo's static-analysis checkers (lightgbm_tpu/analysis/).
+
+Checks the package, tools/ and bench.py against the repo's own
+invariants: jit-capture discipline, guarded-by lock discipline, knob /
+metric / artifact contracts. Stdlib-only and import-free of the code
+under analysis (pure AST) — runs anywhere in ~seconds, no jax.
+
+Exit codes (the check_bench_regression.py convention):
+  0  clean (all findings baselined or none)
+  1  findings (including STALE baseline entries — the file only
+     shrinks toward zero)
+  2  usage error (bad arguments, unreadable/forbidden baseline)
+
+Baseline: ``tools/analysis_baseline.json`` — every entry is a
+``finding key`` plus a one-line justification. jit_capture and
+lock_discipline findings are REFUSED there: deliberate exemptions for
+those live inline next to the code (``# jit-capture: ok(...) —
+reason``, ``# unguarded-ok: reason``).
+
+  python tools/run_analysis.py                # human-readable
+  python tools/run_analysis.py --json         # machine-readable
+  python tools/run_analysis.py --update-baseline   # rewrite baseline
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import sys
+import types
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _load_analysis():
+    """Import lightgbm_tpu.analysis WITHOUT executing the package
+    __init__ (which imports the full engine, jax included): register
+    a path-only stub for ``lightgbm_tpu`` when the real package is
+    not already loaded, then import the analysis subpackage normally.
+    Inside a process that has the real package (the pytest wrapper),
+    this is a plain import."""
+    if "lightgbm_tpu" not in sys.modules:
+        stub = types.ModuleType("lightgbm_tpu")
+        stub.__path__ = [os.path.join(_REPO, "lightgbm_tpu")]
+        sys.modules["lightgbm_tpu"] = stub
+    return (importlib.import_module("lightgbm_tpu.analysis." + name)
+            for name in ("core", "jit_capture", "lock_discipline",
+                         "contracts"))
+
+
+_core, jit_capture, lock_discipline, contracts = _load_analysis()
+Baseline = _core.Baseline
+Finding = _core.Finding
+UsageError = _core.UsageError
+iter_sources = _core.iter_sources
+
+DEFAULT_BASELINE = os.path.join(_REPO, "tools", "analysis_baseline.json")
+
+
+def run_checkers(root: str) -> List[Finding]:
+    sources = iter_sources(root)
+    info = contracts.build_repo_info(sources, root)
+    findings: List[Finding] = []
+    findings += jit_capture.check(sources, info.config_fields)
+    findings += lock_discipline.check(sources)
+    findings += contracts.check(sources, info)
+    findings.sort(key=lambda f: (f.path, f.line, f.key))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="repo-native static analysis (exit 0 clean / "
+                    "1 findings / 2 usage error)")
+    ap.add_argument("--root", default=_REPO,
+                    help="repo root to scan (default: this checkout)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: "
+                         "tools/analysis_baseline.json under --root)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(jit_capture/lock_discipline never written; "
+                         "new entries get a TODO justification to fill)")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(os.path.join(root, "lightgbm_tpu")):
+        print(f"error: {root} does not look like the repo root "
+              "(no lightgbm_tpu/ package)", file=sys.stderr)
+        return 2
+    baseline_path = args.baseline or os.path.join(
+        root, "tools", "analysis_baseline.json")
+
+    try:
+        baseline = Baseline.load(baseline_path)
+        findings = run_checkers(root)
+    except UsageError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    except SyntaxError as e:
+        print(f"error: unparsable source: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        doc = baseline.dump(findings)
+        with open(baseline_path, "w") as fh:   # atomic-ok: dev tool,
+            json.dump(doc, fh, indent=2)       # no concurrent reader
+            fh.write("\n")
+        print(f"baseline written: {baseline_path} "
+              f"({len(doc['entries'])} entries)")
+        # fall through with the FRESH baseline: the run must report
+        # (and exit on) only what is NOT baselineable — not the
+        # findings it just wrote
+        try:
+            baseline = Baseline.load(baseline_path)
+        except UsageError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    kept, suppressed, stale = baseline.apply(findings)
+    stale_findings = [
+        Finding("baseline", "stale-entry",
+                os.path.relpath(baseline_path, root), 1,
+                f"baseline entry no longer matches any finding — "
+                f"remove it: {k}", k)
+        for k in sorted(stale)]
+    report = kept + stale_findings
+
+    if args.json:
+        print(json.dumps({
+            "schema": "lightgbm-tpu/analysis v1",
+            "root": root,
+            "findings": [f.to_json() for f in report],
+            "suppressed_by_baseline": suppressed,
+            "stale_baseline_keys": sorted(stale),
+            "clean": not report,
+        }, indent=2))
+    else:
+        for f in report:
+            print(f.render())
+        print(f"analysis: {len(report)} finding(s), "
+              f"{suppressed} baselined, {len(stale)} stale baseline "
+              f"entr{'y' if len(stale) == 1 else 'ies'}")
+    return 1 if report else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
