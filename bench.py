@@ -16,6 +16,7 @@ CPU number (its strongest in-repo headline baseline).
 """
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -413,6 +414,202 @@ def _auc(y, s):
     return float(m.eval(np.asarray(s, np.float64), None)[0][1])
 
 
+# ---------------------------------------------------------------------------
+# Reference-parity harness (bench.py --parity)
+# ---------------------------------------------------------------------------
+
+# the reference's own GPU-vs-CPU quality bar: test AUC within ~4e-4 of
+# the CPU engine at 63 bins (docs/GPU-Performance.rst) — the ceiling
+# the measured-parity gate asserts when reference LightGBM is present
+PARITY_AUC_TOL = 4e-4
+
+
+def _import_reference_lightgbm():
+    """The reference engine, if this host can import it: the
+    ``lightgbm`` PyPI package, else the fork's python-package under
+    /root/reference. Returns (module, skip_reason) — exactly one is
+    None."""
+    try:
+        import lightgbm as ref
+        return ref, None
+    except ImportError as e:
+        first = str(e)
+    ref_pkg = "/root/reference/python-package"
+    if os.path.isdir(ref_pkg):
+        sys.path.insert(0, ref_pkg)
+        try:
+            import lightgbm as ref
+            return ref, None
+        except Exception as e:  # noqa: BLE001 — a fork without a built
+            # lib_lightgbm.so raises OSError from its loader
+            return None, (f"reference fork at {ref_pkg} not importable:"
+                          f" {e}")
+        finally:
+            sys.path.remove(ref_pkg)
+    return None, f"lightgbm not importable ({first}) and no fork at " \
+                 f"{ref_pkg}"
+
+
+def _train_reference(args, X, y, X_test, y_test):
+    """Train reference LightGBM CPU on the SAME synthetic data and
+    measure {wall, auc}. Returns (stats dict, None) or
+    (None, skip_reason)."""
+    ref, reason = _import_reference_lightgbm()
+    if ref is None:
+        return None, reason
+    params = {
+        "objective": "binary", "metric": "auc",
+        "num_leaves": args.leaves, "max_bin": args.max_bin,
+        "learning_rate": 0.1, "min_data_in_leaf": 20,
+        "verbose": -1,
+    }
+    # hand float32 over as-is — the reference bins float32 natively,
+    # and a float64 copy of the 11M-row matrix would add ~2.5 GB of
+    # peak RSS to a process already holding the engine's state
+    t0 = time.time()
+    dtrain = ref.Dataset(X, label=y)
+    booster = ref.train(params, dtrain, num_boost_round=args.iters)
+    wall = time.time() - t0
+    pred = booster.predict(X_test, raw_score=True)
+    return {
+        # end-to-end wall: the reference's Dataset is lazy, so binning
+        # happens inside train() — this wall covers bin + train, the
+        # same span the engine tiers' wall_s covers (dataset construct
+        # + all iterations incl. compile); vs_measured compares the
+        # two LIKE walls, never a steady-state rate against an
+        # all-inclusive one
+        "ref_wall_s": round(wall, 2),
+        "row_iters_per_s": round(args.rows * args.iters / max(wall, 1e-9)
+                                 / 1e6, 4),
+        "auc_ref": round(_auc(y_test, pred), 6),
+        "version": getattr(ref, "__version__", "unknown"),
+    }, None
+
+
+def _train_tpu_tier(args, X, y, X_test, y_test, tier: str) -> dict:
+    """Train ONE tier of this engine on the same data and measure
+    {tpu_wall, steady row-iters/s, holdout AUC}. ``tier``: "exact" =
+    the f32-grade hi/lo histogram path (autotuned variant), "proxy" =
+    int8 quantization + count-proxy (the headline tier)."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    cfg = Config().set({
+        "objective": "binary", "metric": "auc",
+        "num_leaves": args.leaves, "max_bin": args.max_bin,
+        "learning_rate": 0.1, "min_data_in_leaf": 20,
+        "tpu_stop_check_interval": 10_000,
+        "tpu_quantized_hist": tier == "proxy",
+        "tpu_ingest": 0 if args.no_ingest else -1,
+    })
+    # wall_s spans dataset construction through the last iteration's
+    # readback — the SAME span the reference's lazy Dataset + train()
+    # wall covers, so vs_measured is a like-for-like wall ratio
+    t_all = time.time()
+    ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
+    obj = create_objective("binary", cfg)
+    obj.init(ds.metadata, ds.num_data)
+    g = GBDT()
+    g.init(cfg, ds, obj, [])
+
+    def sync():
+        return float(np.asarray(g._scores[0, :1])[0])
+
+    t1 = time.time()
+    g.train_one_iter()
+    sync()
+    compile_s = time.time() - t1
+    t0 = time.time()
+    for _ in range(args.iters - 1):
+        g.train_one_iter()
+    sync()
+    train_s = time.time() - t0
+    wall = time.time() - t_all
+    parts = []
+    for r0 in range(0, len(X_test), 20_000):
+        parts.append(np.asarray(g.predict_raw(X_test[r0:r0 + 20_000])))
+    auc = _auc(y_test, np.concatenate(parts))
+    out = {
+        "wall_s": round(wall, 2),
+        "compile_s": round(compile_s, 2),
+        "train_s": round(train_s, 2),
+        # steady-state rate (post-compile iterations): the regression
+        # tool's exact-tier floor gates THIS; vs_measured uses wall_s
+        "row_iters_per_s": round(
+            args.rows * (args.iters - 1) / max(train_s, 1e-9) / 1e6, 4),
+        "auc_tpu": round(auc, 6),
+    }
+    if tier == "exact":
+        out["exact_variant"] = g._grower_cfg.exact_variant
+        out["wave_size"] = g._grower_cfg.wave_size
+    return out
+
+
+def parity_bench(args, data=None) -> dict:
+    """The measured reference-parity harness (--parity): BOTH of this
+    engine's tiers (exact hi/lo and int8 count-proxy) AND reference
+    LightGBM CPU trained on the SAME synthetic HIGGS-shaped data,
+    recording {auc_ref, auc_tpu, ref_wall, tpu_wall} so the perf
+    ledger's ``vs_measured`` stands on a measured run instead of the
+    published number — and asserting the reference's own quality bar
+    (|auc_ref - auc_tpu| <= 4e-4 at 63 bins, GPU-Performance.rst).
+    When reference LightGBM cannot be imported the ref fields are null
+    and ``skip_reason`` records why — a recorded skip, not a silent
+    pass. ``data`` reuses the standard bench's already-generated
+    (X, y, X_test, y_test)."""
+    from lightgbm_tpu.ops import autotune
+
+    if data is not None:
+        X, y, X_test, y_test = data
+    else:
+        X, y = make_higgs_like(args.rows + HOLDOUT_ROWS)
+        X_test, y_test = X[args.rows:], y[args.rows:]
+        X, y = X[:args.rows], y[:args.rows]
+
+    tiers = {}
+    for tier in ("exact", "proxy"):
+        tiers[tier] = _train_tpu_tier(args, X, y, X_test, y_test, tier)
+        print(f"# parity {tier}: {tiers[tier]['train_s']:.1f}s train, "
+              f"{tiers[tier]['row_iters_per_s']:.3f} M row-iters/s, "
+              f"AUC {tiers[tier]['auc_tpu']:.5f}", file=sys.stderr)
+    ref, skip = _train_reference(args, X, y, X_test, y_test)
+    if ref is not None:
+        print(f"# parity ref: {ref['ref_wall_s']:.1f}s wall, AUC "
+              f"{ref['auc_ref']:.5f}", file=sys.stderr)
+    else:
+        print(f"# parity ref: SKIPPED — {skip}", file=sys.stderr)
+
+    ok = True
+    for tier, t in tiers.items():
+        if ref is not None:
+            t["ref_wall_s"] = ref["ref_wall_s"]
+            t["auc_ref"] = ref["auc_ref"]
+            t["auc_delta"] = round(abs(t["auc_tpu"] - ref["auc_ref"]), 6)
+            # like-for-like wall ratio: BOTH walls span dataset
+            # construction through the last trained iteration (the
+            # reference's Dataset is lazy — its wall includes binning)
+            t["vs_measured"] = round(
+                ref["ref_wall_s"] / max(t["wall_s"], 1e-9), 3)
+            if t["auc_delta"] > args.parity_auc_tol:
+                ok = False
+        else:
+            t["ref_wall_s"] = t["auc_ref"] = None
+            t["auc_delta"] = t["vs_measured"] = None
+    return {
+        "rows": args.rows, "iters": args.iters, "leaves": args.leaves,
+        "max_bin": args.max_bin,
+        "device_kind": autotune.device_kind(),
+        "ref_available": ref is not None,
+        "skip_reason": skip,
+        "ref": ref,
+        "auc_tol": args.parity_auc_tol,
+        "tiers": tiers,
+        "ok": ok,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=11_000_000)
@@ -497,6 +694,24 @@ def main():
                     help="fraction of explicit cells in the synthetic "
                          "CTR workload (default ~1%%)")
     ap.add_argument("--sparse-iters", type=int, default=30)
+    ap.add_argument("--parity", action="store_true",
+                    help="append the measured reference-parity "
+                         "harness to the standard bench: train BOTH "
+                         "tiers of this engine (exact hi/lo and int8 "
+                         "count-proxy) and reference LightGBM CPU on "
+                         "the same synthetic data, record {auc_ref, "
+                         "auc_tpu, ref_wall, tpu_wall} per tier under "
+                         "'parity' in the JSON line (plus a "
+                         "'vs_measured' sibling of vs_baseline), and "
+                         "assert |auc_ref - auc_tpu| <= "
+                         "--parity-auc-tol (exit 1 on a miss, after "
+                         "the JSON is emitted); a missing reference "
+                         "records a skip reason instead")
+    ap.add_argument("--parity-auc-tol", type=float,
+                    default=PARITY_AUC_TOL,
+                    help="measured AUC-parity ceiling vs reference "
+                         "LightGBM (default 4e-4, the reference's own "
+                         "GPU-vs-CPU bar at 63 bins)")
     args = ap.parse_args()
     if args.slo:
         # refuse a malformed spec NOW, not after an hours-long run
@@ -814,6 +1029,15 @@ def main():
         stream = lrb_stream_bench(args)
         recorder.meta["lrb_stream"] = stream
 
+    # --parity: the measured reference-parity harness — both tiers of
+    # this engine and reference LightGBM CPU on the SAME data, so the
+    # trajectory carries separate exact-tier / proxy-tier throughput
+    # lines and vs_measured stands on a measured reference run
+    parity = None
+    if args.parity:
+        parity = parity_bench(args, data=(X, y, X_test, y_test))
+        recorder.meta["parity"] = parity
+
     # SLO/error-budget section: evaluated over the run's own predict/
     # serve histograms (p99.9 now rides the quantile readout); the
     # regression tool validates the section's shape
@@ -845,6 +1069,8 @@ def main():
         "retrain": retrain,
         "lrb_stream": stream,
         "slo": slo,
+        "parity": parity,
+        "device_kind": autotune.device_kind(),
         "train_auc": round(float(auc), 5),
         "test_auc": round(float(test_auc), 5),
         # quantiles from the log-bucketed histogram, not a sample list:
@@ -864,8 +1090,22 @@ def main():
         "value": round(row_iters_per_s / 1e6, 3),
         "unit": "M row-iters/s",
         "vs_baseline": round(row_iters_per_s / BASELINE_ROW_ITERS_PER_S, 3),
+        # the measured sibling: the parity harness's like-for-like
+        # wall ratio for the tier this headline ran (proxy unless
+        # --no-quant) — ref wall / engine wall, both spanning dataset
+        # construction through the last iteration. Null (with
+        # parity.skip_reason recorded) when the reference is
+        # unavailable or --parity was not requested.
+        "vs_measured": (
+            parity["tiers"]["exact" if args.no_quant
+                            else "proxy"]["vs_measured"]
+            if parity else None),
     }
     print(json.dumps(result))
+    if parity is not None and not parity["ok"]:
+        print(f"# PARITY FAILURE: AUC delta vs measured reference "
+              f"exceeds {args.parity_auc_tol:g}", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

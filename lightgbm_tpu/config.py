@@ -301,11 +301,24 @@ class Config:
     # meshes, ops/autotune.py); 0 = off (f32 psum); 1 = force.
     tpu_quantized_psum: int = -1
     # 4-bit packed HBM bins (the reference's Dense4bitsBin as a COMPUTE
-    # tier, dense_nbits_bin.hpp): when max_bin <= 16 and the count-proxy
-    # int8 path is active, two features share one byte in HBM and the
-    # Pallas kernels unpack nibbles in VMEM — half the bin-matrix HBM,
-    # double the rows/chip. -1 = auto (on when eligible); 0 = off.
+    # tier, dense_nbits_bin.hpp): when max_bin <= 16 and either the
+    # count-proxy int8 path or the hi/lo exact tier (tpu_use_dp) is
+    # active, two features share one byte in HBM and the Pallas
+    # kernels unpack nibbles in VMEM — half the bin-matrix HBM, double
+    # the rows/chip. -1 = auto (on when eligible); 0 = off.
     tpu_packed_bins: int = -1
+    # exact-tier (tpu_use_dp, non-quantized) histogram channel layout
+    # (ops/hist_wave.py): "hilo5" = the 5-channel bf16 hi/lo rows
+    # (wave cap 24); "hilo4" = 4 channels plus a second count dot
+    # (cap 32 — 25% fewer full-data passes per tree); "hilo3" = the
+    # fused hess/count plane (cap 40; constant-unit-hessian objectives
+    # without row weights only — requesting it elsewhere falls back to
+    # hilo4 with a warning). All three reconstruct identical f32-grade
+    # sums. "" = auto: timed once per (features, bins, device) on a
+    # real TPU (ops/autotune.py tune_exact_tier), widest feasible wave
+    # off-TPU (the XLA path is layout-free, so only the wave cap — the
+    # pass count — matters there).
+    tpu_exact_tier: str = ""
     # Pallas kernel autotuning (ops/autotune.py): "on" times a small
     # VMEM-feasible set of tile configurations on the first encounter
     # of a (kernel, features, bins, dtype-tier, device-kind) shape and
@@ -693,6 +706,36 @@ class Config:
             log.warning("tpu_packed_bins=%d is not one of -1/0/1; "
                         "using -1 (auto)", self.tpu_packed_bins)
             self.tpu_packed_bins = -1
+        # unsupported tier combinations fail HERE, at param-check time
+        # with the knob names — not as a bare NotImplementedError from
+        # the kernel dispatch mid-training (ops/hist_wave.py keeps the
+        # raises as a backstop for direct kernel callers)
+        if self.tpu_count_proxy == 1 and not self.tpu_quantized_hist:
+            log.fatal("tpu_count_proxy=1 requires tpu_quantized_hist="
+                      "true (the count-proxy tier rides the int8 "
+                      "quantized histogram kernels); set "
+                      "tpu_quantized_hist=true or drop tpu_count_proxy")
+        if self.tpu_packed_bins == 1:
+            if self.tpu_quantized_hist and self.tpu_count_proxy == 0:
+                log.fatal("tpu_packed_bins=1 with tpu_quantized_hist "
+                          "needs the count-proxy tier: leave "
+                          "tpu_count_proxy enabled (-1/1) or drop "
+                          "tpu_packed_bins")
+            if not self.tpu_quantized_hist and not self.tpu_use_dp:
+                log.fatal("tpu_packed_bins=1 needs the count-proxy "
+                          "int8 tier (tpu_quantized_hist=true) or the "
+                          "hi/lo exact tier (tpu_use_dp=true); "
+                          "single-bf16 (tpu_use_dp=false) packed bins "
+                          "are not implemented")
+            if self.max_bin > 16:
+                log.fatal(f"tpu_packed_bins=1 needs max_bin <= 16 "
+                          f"(two 4-bit bins per byte); max_bin="
+                          f"{self.max_bin}")
+        if self.tpu_exact_tier not in ("", "hilo5", "hilo4", "hilo3"):
+            log.warning("tpu_exact_tier=%r is not one of ''/hilo5/"
+                        "hilo4/hilo3; using '' (auto)",
+                        self.tpu_exact_tier)
+            self.tpu_exact_tier = ""
         if self.tpu_hist_chunk < 0:
             log.warning("tpu_hist_chunk=%d is negative; using 0 "
                         "(auto)", self.tpu_hist_chunk)

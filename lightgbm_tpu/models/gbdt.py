@@ -404,16 +404,62 @@ class GBDT:
                      "conservative lower bounds for the "
                      "min_data_in_leaf gate; set tpu_count_proxy=0 for "
                      "exact counts")
-        # 4-bit packed HBM bins ride the proxy tier (see config)
-        packed4 = (proxy and self.train_data.max_bin_global <= 16
+        # 4-bit packed HBM bins: ride the proxy tier OR the hi/lo
+        # exact tier (the kernels' nibble unpack is channel-layout
+        # independent, so max_bin <= 16 datasets keep half-size HBM
+        # bins under exact semantics too). Forced splits excluded —
+        # the forced prefix reads unpacked bins (ops/wave_grower.py).
+        packed4_exact = (not quant and cfg.tpu_use_dp
+                         and mode in ("serial", "data")
+                         and not self._use_bundles and not sparse_tier
+                         and not cfg.forcedsplits_filename)
+        packed4 = ((proxy or packed4_exact)
+                   and self.train_data.max_bin_global <= 16
                    and cfg.tpu_packed_bins != 0)
+        exact_variant = "hilo5"
         if quant and proxy:
             precision, w_cap = "int8", 64    # 2ch (count-proxy) cap 64
             hp = hp._replace(count_lb=True)  # conservative min_data gate
         elif quant:
             precision, w_cap = "int8", 40    # 3ch cap 42, 8-aligned 40
         elif cfg.tpu_use_dp:
-            precision, w_cap = "highest", 24
+            # exact tier: the hi/lo channel layout (and with it the
+            # wave-width cap — passes per tree) is an autotuned choice
+            # per (F, B, device) among the bit-equivalent variants of
+            # ops/hist_wave.py (tune_exact_tier). Reduced-channel
+            # layouts need the default kernel seams, so feature/voting
+            # learners, EFB bundles and the sparse tier keep "hilo5".
+            # "hilo3" fuses the hess plane with the count plane, which
+            # is only sound when hessians are identically 1 and rows
+            # unweighted (the L1/L2 family without weights; GOSS
+            # amplifies hessians, custom gradients are unknowable) —
+            # see the train_one_iter guard for the custom-grad corner.
+            precision = "highest"
+            if (mode in ("serial", "data") and not self._use_bundles
+                    and not sparse_tier):
+                from ..ops.autotune import (EXACT_TIER_CAPS,
+                                            tune_exact_tier)
+                obj = self.objective
+                const_h = bool(
+                    obj is not None
+                    and getattr(obj, "is_constant_hessian", False)
+                    and cfg.boosting_type() == "gbdt")
+                td_e = self.train_data
+                host_b = td_e.bins
+                exact_variant = tune_exact_tier(
+                    F=max(td_e.num_features, 1),
+                    B=max(td_e.max_bin_global, 2),
+                    n_rows=self._n,
+                    constant_hessian=const_h,
+                    any_cat=bool(hp.has_cat),
+                    bins_bytes=(1 if (host_b.dtype == np.uint8
+                                      if host_b is not None
+                                      else td_e.max_bin_global <= 256)
+                                else 4),
+                    requested=cfg.tpu_exact_tier)
+                w_cap = EXACT_TIER_CAPS[exact_variant]
+            else:
+                w_cap = 24
         else:
             precision, w_cap = "default", 32
         W = cfg.tpu_wave_size or w_cap
@@ -475,6 +521,7 @@ class GBDT:
                 B=(max(td.bundle_width, 2) if bundled else B_hist),
                 W=W, precision=precision, count_proxy=proxy,
                 packed4=packed4, any_cat=bool(hp.has_cat),
+                variant=exact_variant,
                 bins_bytes=(1 if (host_bins.dtype == np.uint8
                                   if host_bins is not None
                                   else td.max_bin_global <= 256)
@@ -617,6 +664,7 @@ class GBDT:
             chunk=kchunk,
             hp=hp,
             precision=precision,
+            exact_variant=exact_variant,
             forced=self._parse_forced_splits(),
             count_proxy=proxy,
             packed4=packed4,
@@ -1327,6 +1375,18 @@ class GBDT:
                 init_scores[k] = self.boost_from_average(k)
             g_in = h_in = self._dummy_gh
         else:
+            if self._grower_cfg.exact_variant == "hilo3":
+                from ..utils.device import on_tpu
+                if on_tpu():
+                    # the hilo3 kernel reads the hess plane AS the
+                    # count plane — custom hessians would silently
+                    # corrupt both (the XLA oracle is layout-free, so
+                    # off-TPU custom gradients are unaffected)
+                    log.fatal(
+                        "custom grad/hess with the hilo3 exact tier: "
+                        "the fused hess/count plane assumes unit "
+                        "hessians; set tpu_exact_tier=hilo4 (or "
+                        "hilo5) for custom-objective training")
             g_in = jnp.asarray(grad, jnp.float32).reshape(K, self._n)
             h_in = jnp.asarray(hess, jnp.float32).reshape(K, self._n)
             pad = self._n_score - self._n

@@ -124,12 +124,16 @@ def fused_hist_block_shapes(*, chunk: int, geom: Dict[str, int],
 def hist_vmem_bytes(*, chunk: int, geom: Dict[str, int], W: int,
                     fused: bool, bins_bytes: int = 1, int8: bool = False,
                     count_proxy: bool = False,
-                    tbl_rows: Optional[int] = None) -> int:
+                    tbl_rows: Optional[int] = None,
+                    variant: Optional[str] = None) -> int:
     """Working-set bytes of one grid step of a wave-histogram kernel,
     priced from the SAME block shapes the BlockSpecs use: grid-indexed
     blocks double-buffered, plus the in-kernel temporaries (the
     transposed one-hot tile, the 128-row weight matrix, one matmul
     accumulator, and — fused — the [W, chunk] partition intermediates).
+    ``variant="hilo4"`` adds the second histogram-shaped count
+    accumulator (and its per-group matmul result) the exact-tier
+    count dot writes.
     """
     oh_bytes = 1 if int8 else 2                  # int8 / bf16 one-hot
     acc_bytes = 4                                # i32 / f32 accumulator
@@ -160,6 +164,12 @@ def hist_vmem_bytes(*, chunk: int, geom: Dict[str, int], W: int,
     b += (geom["gb"] * chunk * oh_bytes          # one-hot tile
           + 128 * chunk * 4                      # weight rows
           + geom["gb_pad"] * 128 * acc_bytes)    # per-group matmul acc
+    if variant == "hilo4":
+        # the count dot's accumulator ref + per-group result + the
+        # f32 membership rows it contracts against
+        b += (_nelem((geom["groups"], geom["gb_pad"], 128)) * 4
+              + geom["gb_pad"] * 128 * 4
+              + 128 * chunk * 4)
     return b
 
 
@@ -445,8 +455,8 @@ def ensure_compile_cache(path: Optional[str] = None,
 def hist_chunk_candidates(*, F: int, B: int, W: int, fused: bool,
                           bins_bytes: int = 1, int8: bool = False,
                           count_proxy: bool = False, packed4: bool = False,
-                          n_rows: int = 0, exhaustive: bool = False
-                          ) -> List[dict]:
+                          n_rows: int = 0, exhaustive: bool = False,
+                          variant: Optional[str] = None) -> List[dict]:
     """VMEM-feasible row-chunk candidates for the wave/fused histogram
     kernels, largest-first. Chunks beyond the dataset's rows are
     pointless (the kernel would pad the whole matrix up); the int8 tier
@@ -465,7 +475,7 @@ def hist_chunk_candidates(*, F: int, B: int, W: int, fused: bool,
         if fits_vmem(hist_vmem_bytes(
                 chunk=c, geom=geom, W=W, fused=fused,
                 bins_bytes=bins_bytes, int8=int8,
-                count_proxy=count_proxy)):
+                count_proxy=count_proxy, variant=variant)):
             out.append({"chunk": c})
     return out[::-1]
 
@@ -473,7 +483,8 @@ def hist_chunk_candidates(*, F: int, B: int, W: int, fused: bool,
 def tune_hist_chunk(*, fused: bool, F: int, B: int, W: int,
                     precision: str = "highest", count_proxy: bool = False,
                     packed4: bool = False, any_cat: bool = False,
-                    bins_bytes: int = 1, n_rows: int = 0) -> int:
+                    bins_bytes: int = 1, n_rows: int = 0,
+                    variant: Optional[str] = None) -> int:
     """The row chunk the histogram hot path should run with — tuned on
     first encounter of this (kernel, F, B, tier, device) key, cached
     thereafter. Off-TPU (and with tpu_autotune=off) this returns the
@@ -484,16 +495,18 @@ def tune_hist_chunk(*, fused: bool, F: int, B: int, W: int,
     from ..utils.device import on_tpu
     if t.mode == "off" or not on_tpu():
         return default
+    variant = variant if precision == "highest" else None
     cands = hist_chunk_candidates(
         F=F, B=B, W=W, fused=fused, bins_bytes=bins_bytes, int8=int8,
         count_proxy=count_proxy, packed4=packed4, n_rows=n_rows,
-        exhaustive=t.mode == "exhaustive")
+        exhaustive=t.mode == "exhaustive", variant=variant)
     if not cands:
         return default
     if len(cands) == 1:
         return int(cands[0]["chunk"])
     tier = precision + ("+proxy" if count_proxy else "") \
-        + ("+packed4" if packed4 else "")
+        + ("+packed4" if packed4 else "") \
+        + (f"+{variant}" if variant not in (None, "hilo5") else "")
     key = {"F": F, "B": B, "W": W, "tier": tier, "fused": fused,
            "cat": bool(any_cat), "bins_bytes": bins_bytes,
            "device": device_kind(),
@@ -506,10 +519,96 @@ def tune_hist_chunk(*, fused: bool, F: int, B: int, W: int,
         fused=fused, F=F, B=B, W=W, precision=precision,
         count_proxy=count_proxy, packed4=packed4, any_cat=any_cat,
         bins_bytes=bins_bytes,
-        n_meas=_hist_measure_rows(cands, F, bins_bytes))
+        n_meas=_hist_measure_rows(cands, F, bins_bytes),
+        variant=variant or "hilo5")
     choice = t.best("fused_hist" if fused else "wave_hist", key, cands,
                     measure, default={"chunk": default})
     return int(choice["chunk"])
+
+
+# ---------------------------------------------------------------------------
+# Exact-tier (precision="highest") channel-layout selection
+# ---------------------------------------------------------------------------
+
+# wave-width cap each exact-tier layout buys (128 MXU lanes / channel
+# count, floor'd to a multiple of 8 for sublane alignment — see
+# ops/hist_wave.py _wave_hist_kernel): the cap is what a variant is FOR
+# (fewer full-data passes per tree), so it doubles as the off-TPU
+# analytic preference order
+EXACT_TIER_CAPS = {"hilo5": 24, "hilo4": 32, "hilo3": 40}
+
+
+def exact_tier_candidates(*, constant_hessian: bool) -> List[dict]:
+    """Feasible exact-tier layouts, widest wave first. ``hilo3`` (the
+    fused hess/count plane) is only sound when the hessian plane is
+    identically the sample mask — constant-unit-hessian objectives
+    without row weights (models/gbdt.py gates this)."""
+    out = [{"variant": "hilo4"}, {"variant": "hilo5"}]
+    if constant_hessian:
+        out.insert(0, {"variant": "hilo3"})
+    return out
+
+
+def tune_exact_tier(*, F: int, B: int, n_rows: int = 0,
+                    constant_hessian: bool = False,
+                    any_cat: bool = False, bins_bytes: int = 1,
+                    requested: str = "", _measure=None) -> str:
+    """The exact-semantics (hi/lo) histogram layout this geometry
+    should run — "hilo5" / "hilo4" / "hilo3" (ops/hist_wave.py).
+
+    ``requested`` is config.tpu_exact_tier ("" = auto). The choice is
+    per (F, B, device) like tune_hist_chunk: on a real TPU the
+    feasible layouts are timed once (fused kernel at each layout's own
+    wave cap, wall NORMALIZED PER SPLIT — t/W — because the layouts
+    trade MXU dots per pass against passes per tree) and the winner is
+    cached; off-TPU the XLA oracle is layout-free, so the variant only
+    sets the wave-width cap and the analytic choice is the widest
+    feasible wave (fewer full-data scatter passes per tree — the
+    measured off-TPU win). tpu_autotune=off pins the pre-variant
+    "hilo5". ``_measure`` injects a fake timer (unit tests)."""
+    if requested:
+        if requested == "hilo3" and not constant_hessian:
+            log.warning(
+                "tpu_exact_tier=hilo3 needs a constant-unit-hessian "
+                "objective without row weights (the fused hess/count "
+                "plane would misread varying hessians); using hilo4")
+            return "hilo4"
+        return requested
+    cands = exact_tier_candidates(constant_hessian=constant_hessian)
+    t = tuner()
+    if t.mode == "off":
+        return "hilo5"
+    from ..utils.device import on_tpu
+    if not on_tpu() and _measure is None:
+        return cands[0]["variant"]
+    key = {"F": F, "B": B, "cat": bool(any_cat),
+           "bins_bytes": bins_bytes, "device": device_kind(),
+           "variants": [c["variant"] for c in cands]}
+    measure = _measure or _exact_tier_measure_fn(
+        F=F, B=B, any_cat=any_cat, bins_bytes=bins_bytes,
+        n_rows=n_rows)
+    choice = t.best("exact_tier", key, cands, measure,
+                    default={"variant": "hilo5"})
+    return str(choice["variant"])
+
+
+def _exact_tier_measure_fn(*, F, B, any_cat, bins_bytes, n_rows):
+    """measure(candidate) for the exact-tier layouts: the fused kernel
+    at the candidate's own wave cap, per-split-normalized (wall / W) —
+    a layout that spends 1.5x the MXU per pass but buys 1.33x the wave
+    width must win or lose on the quotient, not the raw wall."""
+    def measure(cand):
+        v = cand["variant"]
+        W = EXACT_TIER_CAPS[v]
+        chunk_c = [{"chunk": DEFAULT_HIST_CHUNK}]
+        fn = _hist_measure_fn(
+            fused=True, F=F, B=B, W=W, precision="highest",
+            count_proxy=False, packed4=False, any_cat=any_cat,
+            bins_bytes=bins_bytes,
+            n_meas=_hist_measure_rows(chunk_c, F, bins_bytes),
+            variant=v)
+        return fn(chunk_c[0]) / W
+    return measure
 
 
 # ---------------------------------------------------------------------------
@@ -644,7 +743,8 @@ def _hist_measure_rows(cands: List[dict], F: int, bins_bytes: int) -> int:
 
 def _hist_measure_fn(*, fused: bool, F: int, B: int, W: int,
                      precision: str, count_proxy: bool, packed4: bool,
-                     any_cat: bool, bins_bytes: int, n_meas: int):
+                     any_cat: bool, bins_bytes: int, n_meas: int,
+                     variant: str = "hilo5"):
     """Build measure(candidate) for the histogram kernels: synthetic
     data of the real (F, B, tier) shape, one warm-up call per candidate
     (compiles; the persistent compile cache makes reruns cheap), then
@@ -692,7 +792,8 @@ def _hist_measure_fn(*, fused: bool, F: int, B: int, W: int,
                 bins, g, h, mask, leaf_ids, tbl_d, num_bins=B,
                 chunk=chunk, precision=precision, gh_scale=gh_scale,
                 any_cat=any_cat, count_proxy=count_proxy,
-                packed4=packed4, num_features=F if packed4 else None)
+                packed4=packed4, num_features=F if packed4 else None,
+                variant=variant)
     else:
         wl = jnp.asarray(np.concatenate(
             [np.zeros(1, np.int32), np.full(W - 1, -1, np.int32)])
@@ -703,7 +804,7 @@ def _hist_measure_fn(*, fused: bool, F: int, B: int, W: int,
                 bins, g, h, leaf_ids, wl, num_bins=B, chunk=chunk,
                 precision=precision, gh_scale=gh_scale,
                 count_proxy=count_proxy, packed4=packed4,
-                num_features=F if packed4 else None)
+                num_features=F if packed4 else None, variant=variant)
 
     return lambda cand: timing.measure(
         functools.partial(run, int(cand["chunk"])))

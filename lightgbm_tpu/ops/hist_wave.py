@@ -112,17 +112,116 @@ def wave_histogram_xla(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     found = eq.any(axis=0)
     slot = jnp.argmax(eq, axis=0).astype(jnp.int32)       # [N]
     base = jnp.where(found, slot * (F * B), W * F * B)    # OOB -> dropped
+    return _scatter_hist3(bins_t, g, h, base, num_bins=B, num_slots=W)
+
+
+def _scatter_hist3(bins_t, g, h, base, *, num_bins, num_slots):
+    """ONE combined scatter-add of all three channels: per (row,
+    feature) the flat target is ``base_row + f*B + bin`` and the
+    update is the 3-vector (g, h, 1). One pass over the F*N indices
+    instead of three — measured 1.5x on the CPU backend at the bench
+    shape — and BIT-identical to three per-channel scatters (each
+    target's per-channel add sequence is the same row order either
+    way). ``base`` carries each row's wave-slot offset, with
+    out-of-wave rows at the OOB-high sentinel ``num_slots*F*B`` that
+    ``mode="drop"`` discards (negative sentinels would wrap
+    python-style)."""
+    F, n = bins_t.shape
+    B = num_bins
+    size = num_slots * F * B
     flat = (base[None, :] + jnp.arange(F, dtype=jnp.int32)[:, None] * B
             + bins_t.astype(jnp.int32)).ravel()           # [F*N]
-    size = W * F * B
+    vals = jnp.stack([
+        jnp.broadcast_to(g.astype(jnp.float32), (F, n)),
+        jnp.broadcast_to(h.astype(jnp.float32), (F, n)),
+        jnp.broadcast_to(jnp.ones((), jnp.float32), (F, n))],
+        axis=-1).reshape(-1, 3)                           # [F*N, 3]
+    hist = jnp.zeros((size, 3), jnp.float32).at[flat].add(
+        vals, mode="drop")
+    return hist.reshape(num_slots, F, B, 3)
 
-    def scat(vals):
-        v = jnp.broadcast_to(vals.astype(jnp.float32), (F, n)).ravel()
-        return jnp.zeros(size, jnp.float32).at[flat].add(v, mode="drop")
 
-    hist = jnp.stack([scat(g), scat(h),
-                      scat(jnp.ones((), jnp.float32))], axis=1)
-    return hist.reshape(W, F, B, 3)
+# ---------------------------------------------------------------------------
+# Fused partition + wave histogram, XLA formulation (the off-TPU hot path)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "count_proxy",
+                                             "dequant"))
+def fused_partition_histogram_xla(bins_t, g, h, sample_mask, leaf_ids,
+                                  wl, new_ids, feat, tbin, dleft,
+                                  iscat, catw, small_ids, miss, defb,
+                                  nb, *, num_bins, count_proxy=False,
+                                  gh_scale=None, dequant=True):
+    """Partition one wave + build its smaller-child histograms in one
+    traced region — the XLA twin of ``fused_partition_histogram_pallas``
+    for backends without the Pallas kernels (the exact tier's off-TPU
+    hot path).
+
+    What fusing buys over [apply_wave_splits -> wave_histogram_xla]:
+    the leaf-membership compare ``eq`` [W, N] is computed ONCE and
+    reused for (a) the partition's move mask and (b) the smaller-child
+    histogram membership (the unfused pipeline re-derives membership
+    from the POST-split leaf ids — a second [W, N] compare sweep plus
+    an argmax), and the three histogram channels ride one combined
+    scatter (``_scatter_hist3``). BIT-identical to the unfused
+    pipeline: the partition applies the same ``row_goes_right``
+    decisions (rows match at most one slot, so the vectorized
+    destination sum equals the sequential select chain) and the
+    scatter consumes the identical flat-index sequence the oracle
+    builds from the post-split leaf ids.
+
+    Per-slot split parameters ride as [W] vectors (the Pallas kernel's
+    packed table, unpacked): ``wl``/``new_ids``/``small_ids`` are the
+    wave's parent/right-child/smaller-child leaf ids (-1 = inactive
+    slot), ``miss``/``defb``/``nb`` the split features' missing-type /
+    default-bin / bin-count metadata. g/h must be pre-masked by
+    ``sample_mask``; out-of-bag rows partition but never count.
+
+    With ``count_proxy`` also returns each slot's EXACT in-bag
+    moved-row count (the right-child count, from the partition mask —
+    the same synthesis the Pallas fused kernel does). ``gh_scale`` +
+    ``dequant`` mirror the dispatcher's quantized-tier handling: the
+    scatter is exact on integer-valued f32, and dequantization (or the
+    deferred quant-psum wire) happens on the way out.
+    """
+    from .partition import row_goes_right
+
+    F, n = bins_t.shape
+    B = num_bins
+    W = wl.shape[0]
+    i32 = jnp.int32
+    active = wl >= 0
+    safe_feat = jnp.maximum(feat, 0)
+    cols = bins_t[safe_feat].astype(i32)                  # [W, N]
+    right = jax.vmap(
+        lambda c, tb, dl, ms, db, nbk, ic, cw: row_goes_right(
+            c, tb, dl, ms, db, nbk, is_cat=ic, cat_words=cw)
+    )(cols, tbin, dleft, miss, defb, nb, iscat, catw)     # [W, N]
+    eq = (leaf_ids[None, :] == wl[:, None]) & active[:, None]
+    moved = eq & right
+    # destination via (new_id + 1): rows match at most one slot (wave
+    # leaves are distinct), so the masked sum IS the select chain
+    dest1 = jnp.sum(jnp.where(moved, new_ids[:, None] + 1, 0), axis=0)
+    leaf_new = jnp.where(dest1 > 0, dest1 - 1, leaf_ids).astype(i32)
+
+    # smaller-child membership from the ALREADY-COMPUTED masks: row r
+    # lands in slot k's smaller child iff it was in parent k and its
+    # move direction matches the smaller side — no post-split compare
+    in_bag = sample_mask > 0
+    small_right = small_ids == new_ids                    # [W]
+    memb = (eq & (moved == small_right[:, None])
+            & (small_ids >= 0)[:, None] & in_bag[None, :])
+    found = memb.any(axis=0)
+    slot = jnp.argmax(memb, axis=0).astype(i32)
+    base = jnp.where(found, slot * (F * B), W * F * B)
+    hist = _scatter_hist3(bins_t, g, h, base, num_bins=B, num_slots=W)
+    if gh_scale is not None and dequant:
+        hist = hist * _qscale_vec(gh_scale)
+    if not count_proxy:
+        return leaf_new, hist
+    cnt_r = jnp.sum((moved & in_bag[None, :]).astype(jnp.float32),
+                    axis=1)
+    return leaf_new, hist, cnt_r
 
 
 # ---------------------------------------------------------------------------
@@ -216,9 +315,10 @@ def wave_histogram_sparse(sp, g, h, leaf_ids, wave_leaves, *, num_bins,
 # Pallas TPU kernel
 # ---------------------------------------------------------------------------
 
-def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
-                      groups, group_sz, hilo, exact_dot=False,
-                      int8=False, count_proxy=False, packed4=False):
+def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *maybe_cnt,
+                      F, B, W, groups, group_sz, variant,
+                      exact_dot=False, int8=False, count_proxy=False,
+                      packed4=False):
     """One grid step = one row chunk; accumulates into out_ref (VMEM).
 
     Every tensor keeps ROWS ON THE LANE AXIS — no relayouts anywhere:
@@ -229,26 +329,46 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
     bins_ref: [Fp, Ct] feature-major bins (uint8)
     ghl_ref:  [4, Ct] f32 packed rows (grad, hess, leaf_id, 0)
     out_ref:  [groups, gb_pad, 128] accumulated histograms
+    maybe_cnt: with variant="hilo4", a second [groups, gb_pad, 128]
+              accumulator carrying the exact count channels
 
-    With ``hilo`` the weight rows carry bf16 hi/lo decompositions of
-    grad and hess ([g_hi | g_lo | h_hi | h_lo | count] x W, needs
-    5W <= 128): every product the bf16 MXU pass computes is then exact,
-    and hi + lo restores ~16 mantissa bits — the reference's f32
-    histogram accuracy (GPU-Performance.rst) at full bf16 MXU speed.
-    Without it the rows are [g | h | count] x W (3W <= 128) and
-    grad/hess round to bf16 in the multiply.
+    ``variant`` selects the exact-tier (precision="highest") channel
+    layout — bf16 hi/lo decompositions make every MXU product exact,
+    and hi + lo restores ~16 mantissa bits (the reference's f32
+    histogram accuracy, GPU-Performance.rst) at full bf16 MXU speed:
+
+    - "hilo5": [g_hi | g_lo | h_hi | h_lo | count] x W, 5W <= 128 ->
+      W <= 25. One dot per feature group (the original layout).
+    - "hilo4": [g_hi | g_lo | h_hi | h_lo] x W, 4W <= 128 -> W <= 32,
+      with the exact counts accumulated by a SECOND dot of the same
+      one-hot tile against the membership rows into ``maybe_cnt`` —
+      more MXU work per pass, 25% fewer full-data passes per tree
+      (the pass count is what an HBM-bound geometry pays for).
+    - "hilo3": [g_hi | g_lo | count] x W, 3W <= 128 -> W <= 42. The
+      hess plane is FUSED with the count plane — sound ONLY when the
+      hessian is identically the sample mask (constant-unit-hessian
+      objectives: L2/L1/quantile/Huber without row weights), where
+      sum(h) == count bin-for-bin and bit-for-bit (the caller gates
+      this, models/gbdt.py).
+
+    ``variant=None`` (precision="default") keeps the single-bf16 rows
+    [g | h | count] x W (3W <= 128), grad/hess rounding to bf16.
     """
     step = pl.program_id(0)
+    cnt_ref = maybe_cnt[0] if variant == "hilo4" else None
 
     @pl.when(step == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
+        if cnt_ref is not None:
+            cnt_ref[...] = jnp.zeros_like(cnt_ref)
 
     gvec = ghl_ref[0:1, :]                              # [1, Ct]
     hvec = ghl_ref[1:2, :]
     lvec = ghl_ref[2:3, :]
     wl = wl_ref[...]                                    # [Wp, 1]
     mw = ((lvec == wl[:W]) & (wl[:W] >= 0.0)).astype(jnp.float32)
+    cnt_rows = None
     if int8 and count_proxy:
         # count-proxy: 2 channels only (see fused kernel / wave_grower)
         w_rows = jnp.concatenate([mw * gvec, mw * hvec], axis=0)
@@ -257,16 +377,30 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
         # (tpu_quantized_hist, see wave_grower); int8 x int8 -> int32
         # MXU products are exact and run at 2x the bf16 rate
         w_rows = jnp.concatenate([mw * gvec, mw * hvec, mw], axis=0)
-    elif hilo:                                          # mw: [W, Ct]
+    elif variant == "hilo5":                            # mw: [W, Ct]
         g_hi, g_lo = _bf16_split(gvec)
         h_hi, h_lo = _bf16_split(hvec)
         w_rows = jnp.concatenate(
             [mw * g_hi, mw * g_lo, mw * h_hi, mw * h_lo, mw], axis=0)
+    elif variant == "hilo4":
+        g_hi, g_lo = _bf16_split(gvec)
+        h_hi, h_lo = _bf16_split(hvec)
+        w_rows = jnp.concatenate(
+            [mw * g_hi, mw * g_lo, mw * h_hi, mw * h_lo], axis=0)
+        cnt_rows = mw                                   # [W, Ct]
+    elif variant == "hilo3":
+        # constant-unit-hessian layout: the count plane IS the hess
+        # plane (sum over a bin of h == m is exactly its row count)
+        g_hi, g_lo = _bf16_split(gvec)
+        w_rows = jnp.concatenate([mw * g_hi, mw * g_lo, mw], axis=0)
     else:
         w_rows = jnp.concatenate([mw * gvec, mw * hvec, mw], axis=0)
     nrow = w_rows.shape[0]
     if nrow != 128:
         w_rows = jnp.pad(w_rows, ((0, 128 - nrow), (0, 0)))
+    if cnt_rows is not None and cnt_rows.shape[0] != 128:
+        cnt_rows = jnp.pad(cnt_rows,
+                           ((0, 128 - cnt_rows.shape[0]), (0, 0)))
 
     ct = gvec.shape[1]
     Bp = _round_up(B, 8)       # 8-aligned per-feature stride: the
@@ -317,18 +451,39 @@ def _wave_hist_kernel(wl_ref, bins_ref, ghl_ref, out_ref, *, F, B, W,
         if gb_pad != gb:
             acc = jnp.pad(acc, ((0, gb_pad - gb), (0, 0)))
         out_ref[p, :, :] += acc
+        if cnt_rows is not None:
+            # hilo4: the count channels ride a SECOND dot of the SAME
+            # one-hot tile against the membership rows (0/1 products
+            # are exact in bf16; integer sums < 2^24 are exact in f32)
+            cnt_mm = (cnt_rows if exact_dot
+                      else cnt_rows.astype(jnp.bfloat16))
+            acc_c = jax.lax.dot_general(
+                oh_t, cnt_mm, dimension_numbers=(((1,), (1,)), ((), ())),
+                precision=(jax.lax.Precision.HIGHEST if exact_dot
+                           else jax.lax.Precision.DEFAULT),
+                preferred_element_type=jnp.float32)
+            if gb_pad != gb:
+                acc_c = jnp.pad(acc_c, ((0, gb_pad - gb), (0, 0)))
+            cnt_ref[p, :, :] += acc_c
+
+
+def _exact_nchan(variant) -> int:
+    """MXU weight-row channels per wave slot of an exact-tier
+    (precision="highest") layout — the lane-budget denominator
+    (128 // nchan = the wave-width cap the variant buys)."""
+    return {"hilo5": 5, "hilo4": 4, "hilo3": 3}[variant]
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_bins", "chunk", "interpret",
                                     "precision", "count_proxy",
                                     "packed4", "num_features",
-                                    "dequant"))
+                                    "dequant", "variant"))
 def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                           chunk=2048, interpret=False, precision="highest",
                           gh_scale=None, count_proxy=False,
                           packed4=False, num_features=None,
-                          dequant=True):
+                          dequant=True, variant="hilo5"):
     """Pallas wave histogram — same contract as wave_histogram_xla.
 
     Grid over row chunks; per chunk the kernel builds the leaf-membership
@@ -351,9 +506,11 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     """
     F, n = bins_t.shape
     if packed4:
-        if not count_proxy or num_bins > 16:
+        if num_bins > 16:
+            raise NotImplementedError("packed4 needs max_bin <= 16")
+        if not (count_proxy or precision == "highest"):
             raise NotImplementedError(
-                "packed4 needs count_proxy and max_bin <= 16")
+                "packed4 needs the count-proxy or hi/lo exact tier")
         F = int(num_features)
     W = int(wave_leaves.shape[0])
     B = num_bins
@@ -361,7 +518,9 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     if count_proxy and not int8:
         raise NotImplementedError("count_proxy requires precision='int8'")
     hilo = precision == "highest"
-    nchan = (2 if count_proxy else 3) if int8 else 5 if hilo else 3
+    variant = variant if hilo else None
+    nchan = ((2 if count_proxy else 3) if int8
+             else _exact_nchan(variant) if hilo else 3)
     ncol = nchan * W
     if ncol > 128:
         raise NotImplementedError(
@@ -395,11 +554,21 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
 
     kernel = functools.partial(
         _wave_hist_kernel, F=F, B=B, W=W, groups=groups,
-        group_sz=group_sz, hilo=hilo, exact_dot=interpret and not int8,
+        group_sz=group_sz, variant=variant,
+        exact_dot=interpret and not int8,
         int8=int8, count_proxy=count_proxy, packed4=packed4)
 
     blk = autotune.wave_hist_block_shapes(chunk=chunk, geom=geom)
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec(blk["hist"], lambda i: (0, 0, 0),
+                              memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct(
+        blk["hist"], jnp.int32 if int8 else jnp.float32)]
+    if variant == "hilo4":
+        # second accumulator: the count-dot channels (f32, W lanes)
+        out_specs.append(pl.BlockSpec(blk["hist"], lambda i: (0, 0, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct(blk["hist"], jnp.float32))
+    outs = pl.pallas_call(
         kernel,
         grid=(n_pad // chunk,),
         in_specs=[
@@ -410,26 +579,43 @@ def wave_histogram_pallas(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
             pl.BlockSpec(blk["ghl"], lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(blk["hist"], lambda i: (0, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            blk["hist"], jnp.int32 if int8 else jnp.float32),
+        out_specs=(out_specs[0] if len(out_specs) == 1
+                   else tuple(out_specs)),
+        out_shape=(out_shape[0] if len(out_shape) == 1
+                   else tuple(out_shape)),
         # the unrolled group loop's temporaries exceed the 16 MB default
         # scoped-vmem cap; v5e has 128 MB physical VMEM
         compiler_params=autotune.tpu_compiler_params(),
         interpret=interpret,
     )(wl, bins_t, ghl)
+    out = outs[0] if variant == "hilo4" else outs
 
     # [groups, gb_pad, 128] -> [F, B, ncol] -> [W, F, B, 3]
     # (feature rows sit at the aligned Bp stride; slice back to B)
     out = out[:, :gb, :ncol].reshape(
         groups * group_sz, geom["Bp"], ncol)[:F, :B]
-    if hilo:
+    if variant == "hilo5":
         out = out.reshape(F, B, 5, W)
         out = jnp.stack([out[:, :, 0] + out[:, :, 1],     # g = hi + lo
                          out[:, :, 2] + out[:, :, 3],     # h = hi + lo
                          out[:, :, 4]], axis=2)           # count
         return out.transpose(3, 0, 1, 2)
+    if variant == "hilo4":
+        cnt = outs[1][:, :gb, :W].reshape(
+            groups * group_sz, geom["Bp"], W)[:F, :B]     # [F, B, W]
+        out = out.reshape(F, B, 4, W)
+        out = jnp.stack([out[:, :, 0] + out[:, :, 1],     # g = hi + lo
+                         out[:, :, 2] + out[:, :, 3],     # h = hi + lo
+                         cnt], axis=2)                    # count (dot 2)
+        return out.transpose(3, 0, 1, 2)
+    if variant == "hilo3":
+        out = out.reshape(F, B, 3, W)
+        # the fused hess/count plane serves both output channels:
+        # h == sample mask (constant-unit-hessian gate), so the bin's
+        # hess sum IS its count
+        return jnp.stack([out[:, :, 0] + out[:, :, 1],    # g = hi + lo
+                          out[:, :, 2],                   # h = count
+                          out[:, :, 2]], axis=2).transpose(3, 0, 1, 2)
     if count_proxy:
         out = out.reshape(F, B, 2, W).transpose(3, 0, 1, 2)
         if not dequant:
@@ -453,7 +639,8 @@ def _qscale_vec(gh_scale):
 
 def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
                    chunk=0, use_pallas=None, precision="highest",
-                   gh_scale=None, count_proxy=False, dequant=True):
+                   gh_scale=None, count_proxy=False, dequant=True,
+                   variant="hilo5"):
     """Dispatch: Pallas on TPU, XLA elsewhere (or force via use_pallas).
 
     precision="int8": g/h are integer-valued (quantized) and gh_scale
@@ -464,7 +651,10 @@ def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
     kernel raw int32).
     count_proxy: the Pallas kernel returns 2 channels (g, h); the XLA
     oracle still returns 3 exact channels — proxy callers overwrite
-    the count channel either way (wave_grower.bound_counts)."""
+    the count channel either way (wave_grower.bound_counts).
+    variant: exact-tier channel layout (precision="highest" only; see
+    _wave_hist_kernel) — the XLA oracle is layout-free, so only the
+    Pallas kernel consumes it."""
     if use_pallas is None:
         from ..utils.device import on_tpu
         use_pallas = on_tpu()
@@ -473,7 +663,7 @@ def wave_histogram(bins_t, g, h, leaf_ids, wave_leaves, *, num_bins,
             bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
             chunk=chunk or autotune.DEFAULT_HIST_CHUNK,
             precision=precision, gh_scale=gh_scale,
-            count_proxy=count_proxy, dequant=dequant)
+            count_proxy=count_proxy, dequant=dequant, variant=variant)
     out = wave_histogram_xla(
         bins_t, g, h, leaf_ids, wave_leaves, num_bins=num_bins,
         chunk=0, precision="highest")
@@ -495,6 +685,9 @@ TBL_ROWS = 24           # padded to an int32 sublane multiple
 
 FUSED_MAX_WAVE = 32          # 4 channels x W <= 128 MXU lanes (bf16 h)
 FUSED_MAX_WAVE_HILO = 24     # 5 channels, kept a multiple of 8
+FUSED_MAX_WAVE_HILO4 = 32    # 4 channels + a count dot (exact tier)
+FUSED_MAX_WAVE_HILO3 = 40    # 3 channels (fused hess/count plane),
+                             # 42 floor'd to a multiple of 8
 FUSED_MAX_WAVE_INT8 = 42     # 3 channels (int8 gq/hq/count)
 FUSED_MAX_WAVE_INT8_NC = 64  # 2 channels (count-proxy mode: the MXU dot
                              # carries only gq/hq; per-bin counts are
@@ -505,7 +698,7 @@ FUSED_MAX_WAVE_INT8_NC = 64  # 2 channels (count-proxy mode: the MXU dot
 
 def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
                   hist_ref, leaf_out_ref, *maybe_cnt, F, B, W, groups,
-                  group_sz, hilo, exact_dot=False, int8=False,
+                  group_sz, variant, exact_dot=False, int8=False,
                   any_cat=True, count_proxy=False, packed4=False):
     """One grid step: partition one row chunk by the wave's W splits,
     then accumulate the wave's smaller-child histograms — ONE data pass.
@@ -528,19 +721,24 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
     hist_ref:  [groups, gb_pad, 128] accumulated histograms
     leaf_out_ref: [1, Ct] i32 leaf ids AFTER this wave
 
-    Channel layout: with ``hilo`` (tpu_use_dp) both grad and hess ride
-    exact bf16 hi/lo halves ([g_hi | g_lo | h_hi | h_lo | count] x W,
-    5W <= 128 -> W <= 24) — the documented f32-grade accumulation.
-    Without it: [g_hi | g_lo | h | count] x W (4W <= 128 -> W <= 32),
-    hessian single bf16 (2^-9 relative rounding). Counts exact always.
+    Channel layout: the exact tier (tpu_use_dp) rides one of the
+    ``variant`` layouts of _wave_hist_kernel — "hilo5"
+    ([g_hi | g_lo | h_hi | h_lo | count] x W, W <= 24), "hilo4" (the
+    count channel moves to a second dot into ``maybe_cnt``, W <= 32)
+    or "hilo3" (the fused hess/count plane for constant-unit-hessian
+    objectives, W <= 40) — all with exact bf16 products and f32-grade
+    hi + lo reconstruction. ``variant=None`` (precision="default"):
+    [g_hi | g_lo | h | count] x W (W <= 32), hessian single bf16
+    (2^-9 relative rounding). Counts exact in every layout.
     """
     step = pl.program_id(0)
-    cnt_ref = maybe_cnt[0] if count_proxy else None
+    cnt_ref = (maybe_cnt[0] if count_proxy or variant == "hilo4"
+               else None)
 
     @pl.when(step == 0)
     def _():
         hist_ref[...] = jnp.zeros_like(hist_ref)
-        if count_proxy:
+        if cnt_ref is not None:
             cnt_ref[...] = jnp.zeros_like(cnt_ref)
 
     i32 = jnp.int32
@@ -683,19 +881,36 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
         # in [-127, 127]; int8 MXU products, exact int32 sums, 2x rate
         w_rows = jnp.concatenate(
             [m * gvec, m * hvec, m * mvec], axis=0)          # [3W, Ct]
-    elif hilo:
+    elif variant == "hilo5":
         g_hi, g_lo = _bf16_split(gvec)
         h_hi, h_lo = _bf16_split(hvec)
         w_rows = jnp.concatenate(
             [m * g_hi, m * g_lo, m * h_hi, m * h_lo, m * mvec],
             axis=0)                                          # [5W, Ct]
+    elif variant == "hilo4":
+        # count channels move to a second dot (see _wave_hist_kernel)
+        g_hi, g_lo = _bf16_split(gvec)
+        h_hi, h_lo = _bf16_split(hvec)
+        w_rows = jnp.concatenate(
+            [m * g_hi, m * g_lo, m * h_hi, m * h_lo], axis=0)  # [4W, Ct]
+        cnt_rows = m * mvec
+    elif variant == "hilo3":
+        # fused hess/count plane (h == mask, see _wave_hist_kernel)
+        g_hi, g_lo = _bf16_split(gvec)
+        w_rows = jnp.concatenate(
+            [m * g_hi, m * g_lo, m * mvec], axis=0)          # [3W, Ct]
     else:
         g_hi, g_lo = _bf16_split(gvec)
         w_rows = jnp.concatenate(
             [m * g_hi, m * g_lo, m * hvec, m * mvec], axis=0)  # [4W, Ct]
+    if variant != "hilo4":
+        cnt_rows = None
     nrow = w_rows.shape[0]
     if nrow != 128:
         w_rows = jnp.pad(w_rows, ((0, 128 - nrow), (0, 0)))
+    if cnt_rows is not None and cnt_rows.shape[0] != 128:
+        cnt_rows = jnp.pad(cnt_rows,
+                           ((0, 128 - cnt_rows.shape[0]), (0, 0)))
 
     # ---- one-hot tiles + lane-contracting MXU accumulate ----
     Bp = _round_up(B, 8)       # aligned per-feature stride (see
@@ -735,20 +950,34 @@ def _fused_kernel(tbl_ref, binsf_ref, ghm_ref, leaf_ref,
         if gb_pad != gb:
             acc = jnp.pad(acc, ((0, gb_pad - gb), (0, 0)))
         hist_ref[p, :, :] += acc
+        if cnt_rows is not None:
+            # hilo4 count dot (same one-hot tile; exact 0/1 products)
+            cnt_mm = (cnt_rows if exact_dot
+                      else cnt_rows.astype(jnp.bfloat16))
+            acc_c = jax.lax.dot_general(
+                oh_t, cnt_mm,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                precision=(jax.lax.Precision.HIGHEST if exact_dot
+                           else jax.lax.Precision.DEFAULT),
+                preferred_element_type=jnp.float32)
+            if gb_pad != gb:
+                acc_c = jnp.pad(acc_c, ((0, gb_pad - gb), (0, 0)))
+            cnt_ref[p, :, :] += acc_c
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "chunk",
                                              "interpret", "precision",
                                              "any_cat", "count_proxy",
                                              "packed4", "num_features",
-                                             "dequant"))
+                                             "dequant", "variant"))
 def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
                                      leaf_ids, tbl, *, num_bins,
                                      chunk=2048, interpret=False,
                                      precision="highest",
                                      gh_scale=None, any_cat=True,
                                      count_proxy=False, packed4=False,
-                                     num_features=None, dequant=True):
+                                     num_features=None, dequant=True,
+                                     variant="hilo5"):
     """Partition one wave + build its smaller-child histograms in ONE
     data pass. Returns (new_leaf_ids [N], hist [W, F, B, 3]) — or, with
     ``count_proxy``, (new_leaf_ids, hist [W, F, B, 2], cnt_right [W]).
@@ -771,18 +1000,21 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     EXACT in-bag row count moved to the new (right) child; per-bin
     count estimates are synthesized downstream (wave_grower).
 
-    packed4 (count-proxy tier only): ``bins_t`` is [ceil(F/2), N] with
-    TWO features' 4-bit bins per byte (feature 2p in the low nibble of
-    row p) — half the HBM residency for max_bin <= 16 datasets, like
-    the reference's Dense4bitsBin (dense_nbits_bin.hpp); the kernel
-    unpacks nibbles in VMEM. ``num_features`` gives the logical F.
+    packed4 (count-proxy or hi/lo exact tier): ``bins_t`` is
+    [ceil(F/2), N] with TWO features' 4-bit bins per byte (feature 2p
+    in the low nibble of row p) — half the HBM residency for
+    max_bin <= 16 datasets, like the reference's Dense4bitsBin
+    (dense_nbits_bin.hpp); the kernel unpacks nibbles in VMEM. The
+    nibble unpack is channel-layout-independent, so the exact hi/lo
+    variants compose with it. ``num_features`` gives the logical F.
     """
     F, n = bins_t.shape
     if packed4:
-        if not count_proxy:
-            raise NotImplementedError("packed4 requires count_proxy")
         if num_bins > 16:
             raise NotImplementedError("packed4 needs max_bin <= 16")
+        if not (count_proxy or precision == "highest"):
+            raise NotImplementedError(
+                "packed4 needs the count-proxy or hi/lo exact tier")
         F = int(num_features)
     W = int(tbl.shape[1])
     B = num_bins
@@ -790,16 +1022,21 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
     if count_proxy and not int8:
         raise NotImplementedError("count_proxy requires precision='int8'")
     hilo = precision == "highest"
+    variant = variant if hilo else None
     cap = (FUSED_MAX_WAVE_INT8_NC if int8 and count_proxy
            else FUSED_MAX_WAVE_INT8 if int8
-           else FUSED_MAX_WAVE_HILO if hilo else FUSED_MAX_WAVE)
+           else {"hilo5": FUSED_MAX_WAVE_HILO,
+                 "hilo4": FUSED_MAX_WAVE_HILO4,
+                 "hilo3": FUSED_MAX_WAVE_HILO3}[variant] if hilo
+           else FUSED_MAX_WAVE)
     if W > cap:
         raise NotImplementedError(f"fused wave needs W <= {cap}")
     if int8 and 127 * (n + (-n) % chunk) >= 2 ** 31:
         raise NotImplementedError(
             "int8 histogram sums could overflow int32 beyond ~16.9M "
             "rows; disable tpu_quantized_hist")
-    nchan = (2 if count_proxy else 3) if int8 else 5 if hilo else 4
+    nchan = ((2 if count_proxy else 3) if int8
+             else _exact_nchan(variant) if hilo else 4)
     # tile geometry + block shapes from the shared source of truth the
     # autotuner's VMEM predicate prices (ops/autotune.py)
     geom = autotune.hist_geometry(F=F, B=B, W=W, F_rows=bins_t.shape[0])
@@ -827,7 +1064,7 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
 
     kernel = functools.partial(
         _fused_kernel, F=F, B=B, W=W, groups=groups, group_sz=group_sz,
-        hilo=hilo, exact_dot=interpret and not int8, int8=int8,
+        variant=variant, exact_dot=interpret and not int8, int8=int8,
         any_cat=any_cat, count_proxy=count_proxy, packed4=packed4)
 
     blk = autotune.fused_hist_block_shapes(chunk=chunk, geom=geom,
@@ -847,6 +1084,11 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
         out_specs.append(pl.BlockSpec(blk["cnt"], lambda i: (0, 0),
                                       memory_space=pltpu.VMEM))
         out_shape.append(jax.ShapeDtypeStruct(blk["cnt"], jnp.float32))
+    elif variant == "hilo4":
+        # second histogram-shaped accumulator: the count-dot channels
+        out_specs.append(pl.BlockSpec(blk["hist"], lambda i: (0, 0, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct(blk["hist"], jnp.float32))
     outs = pl.pallas_call(
         kernel,
         grid=(n_pad // chunk,),
@@ -886,10 +1128,20 @@ def fused_partition_histogram_pallas(bins_t, g, h, sample_mask,
         if dequant:
             hist = hist.astype(jnp.float32) * _qscale_vec(gh_scale)
         return leaf_out[0, :n], hist.transpose(2, 0, 1, 3)
-    if hilo:
+    if variant == "hilo5":
         hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo
                           hist[:, :, 2] + hist[:, :, 3],   # h = hi+lo
                           hist[:, :, 4]], axis=2)          # count
+    elif variant == "hilo4":
+        cnt = outs[2][:, :gb, :W].reshape(
+            groups * group_sz, Bp, W)[:F, :B]              # [F, B, W]
+        hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo
+                          hist[:, :, 2] + hist[:, :, 3],   # h = hi+lo
+                          cnt], axis=2)                    # count (dot 2)
+    elif variant == "hilo3":
+        hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo
+                          hist[:, :, 2],                   # h = count
+                          hist[:, :, 2]], axis=2)          # count
     else:
         hist = jnp.stack([hist[:, :, 0] + hist[:, :, 1],   # g = hi+lo
                           hist[:, :, 2],                   # h (bf16)

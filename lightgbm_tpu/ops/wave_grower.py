@@ -58,6 +58,14 @@ class WaveGrowerConfig(NamedTuple):
     # decomposition (f32-grade sums, W <= 25), "default" = single bf16
     # (W <= 42/32). Plumbed from config.tpu_use_dp.
     precision: str = "highest"
+    # exact-tier channel layout (precision="highest" only; autotuned
+    # per geometry, ops/autotune.py tune_exact_tier): "hilo5" = the
+    # original 5-channel hi/lo rows (W <= 24); "hilo4" = 4 channels +
+    # a second count dot (W <= 32); "hilo3" = the fused hess/count
+    # plane for constant-unit-hessian objectives (W <= 40). All three
+    # reconstruct identical f32-grade sums (ops/hist_wave.py); the
+    # wave-width cap — passes per tree — is what they trade.
+    exact_variant: str = "hilo5"
     # fused partition+histogram kernel (ONE data pass per wave instead
     # of W partition passes + a histogram pass). None = auto: on
     # whenever the Pallas path is on and W fits; interpret mode is used
@@ -260,8 +268,13 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     if proxy and (hist_fn is not None or partition_fn is not None):
         raise ValueError("count_proxy does not compose with injected "
                          "histogram/partition seams")
-    if cfg.packed4 and not proxy:
-        raise ValueError("packed4 bins require count_proxy mode")
+    if cfg.packed4 and not (proxy or cfg.precision == "highest"):
+        raise ValueError("packed4 bins require the count-proxy or "
+                         "hi/lo exact tier")
+    if cfg.packed4 and cfg.forced:
+        raise ValueError("packed4 does not compose with forced splits "
+                         "(the forced prefix reads unpacked bins); "
+                         "disable tpu_packed_bins")
     if cfg.sparse_hist and (proxy or cfg.packed4 or cfg.quant_psum):
         raise ValueError("sparse_hist does not compose with "
                          "count_proxy/packed4/quant_psum")
@@ -287,22 +300,55 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         # those as if they were the int32 wire would double-scale
         raise ValueError("quant_psum does not compose with injected "
                          "histogram/partition seams")
+    if cfg.exact_variant not in ("hilo5", "hilo4", "hilo3"):
+        raise ValueError(f"unknown exact_variant {cfg.exact_variant!r}")
+    if cfg.exact_variant != "hilo5":
+        if cfg.precision != "highest":
+            raise ValueError("exact_variant applies to the exact tier "
+                             "(precision='highest') only")
+        if hist_fn is not None or partition_fn is not None \
+                or cfg.sparse_hist:
+            # injected seams build their own histogram layout; the
+            # sparse tier scatters (layout-free) but the grower's wave
+            # cap must then stay at the injected seam's contract
+            raise ValueError("exact_variant does not compose with "
+                             "injected histogram/partition seams or "
+                             "the sparse tier")
+    bundled = jnp.ndim(meta_const.bundle) != 0
     use_fused = cfg.fused
     if use_fused is None:
         from .hist_wave import (FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO,
+                                FUSED_MAX_WAVE_HILO3,
+                                FUSED_MAX_WAVE_HILO4,
                                 FUSED_MAX_WAVE_INT8,
                                 FUSED_MAX_WAVE_INT8_NC)
         fused_cap = (FUSED_MAX_WAVE_INT8_NC if quant and proxy
                      else FUSED_MAX_WAVE_INT8 if quant
-                     else FUSED_MAX_WAVE_HILO
+                     else {"hilo5": FUSED_MAX_WAVE_HILO,
+                           "hilo4": FUSED_MAX_WAVE_HILO4,
+                           "hilo3": FUSED_MAX_WAVE_HILO3}[
+                               cfg.exact_variant]
                      if cfg.precision == "highest" else FUSED_MAX_WAVE)
-        bundled = jnp.ndim(meta_const.bundle) != 0
         use_fused = (default_seams and W <= fused_cap
                      and not bundled and not cfg.sparse_hist
                      and _pallas_on(cfg.use_pallas))
     if use_fused:
         from ..utils.device import on_tpu
         fused_interpret = not on_tpu()
+    # off-TPU twin of the fused kernel (ops/hist_wave.py
+    # fused_partition_histogram_xla): partition + smaller-child
+    # histogram in one traced region, reusing the leaf-membership
+    # compares between the two and riding ONE combined scatter —
+    # bit-identical to [partition_fn -> hist_fn], so it is the default
+    # off-TPU route wherever the Pallas fused kernel would be the
+    # on-TPU one. cfg.fused=False opts out (the legacy two-pass
+    # pipeline, kept as the parity oracle).
+    use_fused_xla = (not use_fused and cfg.fused is not False
+                     and default_seams and not bundled
+                     and not cfg.sparse_hist
+                     and not _pallas_on(cfg.use_pallas))
+    if use_fused_xla:
+        from .hist_wave import fused_partition_histogram_xla
 
     if hist_fn is None and cfg.sparse_hist:
         # sparse tier: the histogram source is the (dense bins, sparse
@@ -322,7 +368,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                   use_pallas=cfg.use_pallas,
                                   precision=cfg.precision,
                                   gh_scale=gh_scale,
-                                  dequant=not defer)
+                                  dequant=not defer,
+                                  variant=cfg.exact_variant)
 
     # default split/partition seams take meta as a CALL parameter (the
     # compiled-step registry passes a traced override); injected seams
@@ -502,19 +549,20 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         root_wl = jnp.concatenate(
             [jnp.zeros(1, jnp.int32), jnp.full(W - 1, -1, jnp.int32)])
         leaf0 = jnp.zeros(n, jnp.int32)
-        if use_fused and proxy:
-            # proxy root: the partition-free wave kernel in 2-channel
-            # mode (wave_histogram_pallas count_proxy) — no partition
-            # logic to pay for on an unsplit tree
+        if use_fused and (proxy or cfg.packed4):
+            # proxy/packed4 root: the partition-free wave kernel in the
+            # matching tier — no partition logic to pay for on an
+            # unsplit tree, and (packed4) the default hist_fn never
+            # sees the packed byte rows the fused path keeps in HBM
             from .hist_wave import wave_histogram_pallas
             local_root = wave_histogram_pallas(
                 bins_t, hg, hh, bag_mask_ids(leaf0), root_wl,
                 num_bins=B, chunk=cfg.chunk or DEFAULT_HIST_CHUNK,
                 interpret=fused_interpret, precision=cfg.precision,
-                gh_scale=gh_scale, count_proxy=True,
+                gh_scale=gh_scale, count_proxy=proxy,
                 packed4=cfg.packed4,
                 num_features=F if cfg.packed4 else None,
-                dequant=not defer)
+                dequant=not defer, variant=cfg.exact_variant)
         else:
             local_root = call_hist(hsrc, bag_mask_ids(leaf0),
                                    root_wl)              # [W, F, B, 3]
@@ -664,13 +712,33 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     any_cat=bool(hp.has_cat), count_proxy=proxy,
                     packed4=cfg.packed4,
                     num_features=F if cfg.packed4 else None,
-                    dequant=not defer)
+                    dequant=not defer, variant=cfg.exact_variant)
                 leaf_ids, hist_small = fused_out[0], fused_out[1]
                 hist_small = dq(hist_reduce_fn(hist_small))
                 if proxy:
                     cnt_r = reduce_fn(fused_out[2])
                 # out-of-bag rows partition too; their g/h are pre-masked
                 # and the count channel rides on sample_mask
+            elif use_fused_xla:
+                # off-TPU fused route: one traced partition+histogram
+                # region reusing the membership compares and the
+                # combined 3-channel scatter — bit-identical to the
+                # legacy [partition_fn -> call_hist] pipeline below
+                safe_feat = jnp.maximum(feat, 0)
+                fx = fused_partition_histogram_xla(
+                    bins_t, hg, hh, sample_mask, state.leaf_ids,
+                    wl, new_ids, feat, tbin, dleft, iscat, catw,
+                    small_ids,
+                    meta.missing_type[safe_feat],
+                    meta.default_bin[safe_feat],
+                    meta.num_bin[safe_feat],
+                    num_bins=B, count_proxy=proxy,
+                    gh_scale=gh_scale if quant else None,
+                    dequant=not defer)
+                leaf_ids = fx[0]
+                hist_small = dq(hist_reduce_fn(fx[1]))
+                if proxy:
+                    cnt_r = reduce_fn(fx[2])
             else:
                 leaf_ids = partition_fn(bins_t, state.leaf_ids, wl,
                                         new_ids, feat, tbin, dleft,
@@ -928,7 +996,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         )
         return rec, state.leaf_ids
 
-    # jit-capture: ok(B, hp, cfg, quant, use_fused, meta_const,
+    # jit-capture: ok(B, hp, cfg, quant, use_fused, use_fused_xla,
+    # fused_partition_histogram_xla, meta_const,
     # bound_counts, depth_ok, hist_fn, hist_reduce_fn, reduce_fn,
     # max_reduce_fn, row_offset_fn, split_fn, partition_fn) —
     # factory-scoped jit: every capture derives from this factory

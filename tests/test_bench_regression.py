@@ -361,6 +361,122 @@ def _slo_block(**kw):
     return d
 
 
+# -- measured-parity section (bench.py --parity) -----------------------------
+
+def _parity(ref_available=True, exact_rate=0.02, proxy_rate=0.034,
+            auc_delta=1e-4, ok=True, **kw):
+    tier = lambda rate: {  # noqa: E731
+        "wall_s": 100.0, "row_iters_per_s": rate,
+        "auc_tpu": 0.8626,
+        "ref_wall_s": 120.0 if ref_available else None,
+        "auc_ref": 0.8627 if ref_available else None,
+        "auc_delta": auc_delta if ref_available else None,
+    }
+    d = {"rows": 65536, "iters": 20, "leaves": 63, "max_bin": 63,
+         "device_kind": "cpu", "ref_available": ref_available,
+         "skip_reason": None if ref_available else "no lightgbm here",
+         "auc_tol": 4e-4, "ok": ok,
+         "tiers": {"exact": tier(exact_rate), "proxy": tier(proxy_rate)}}
+    d.update(kw)
+    return d
+
+
+def test_check_schema_parity_section():
+    assert cbr.check_schema(_fresh(parity=_parity())) == []
+    assert cbr.check_schema(
+        _fresh(parity=_parity(ref_available=False))) == []
+    # tier numbers missing
+    bad = _parity()
+    del bad["tiers"]["exact"]["row_iters_per_s"]
+    assert any("exact.row_iters_per_s" in p
+               for p in cbr.check_schema(_fresh(parity=bad)))
+    # reference measured but its fields lost
+    bad = _parity()
+    bad["tiers"]["proxy"]["auc_ref"] = None
+    assert any("proxy.auc_ref" in p
+               for p in cbr.check_schema(_fresh(parity=bad)))
+    # unavailable reference must record why
+    bad = _parity(ref_available=False)
+    bad["skip_reason"] = ""
+    assert any("skip_reason" in p
+               for p in cbr.check_schema(_fresh(parity=bad)))
+    assert any("not a dict" in p
+               for p in cbr.check_schema(_fresh(parity=[1])))
+
+
+def test_parity_quality_problems_are_self_gates():
+    """A measured AUC miss fails the fresh artifact with no baseline
+    needed; skipped-reference runs assert nothing."""
+    assert cbr.parity_quality_problems(_fresh(parity=_parity())) == []
+    bad = cbr.parity_quality_problems(
+        _fresh(parity=_parity(auc_delta=9e-4, ok=False)))
+    assert any("AUC delta" in p for p in bad)
+    assert any("parity.ok" in p for p in bad)
+    assert cbr.parity_quality_problems(
+        _fresh(parity=_parity(ref_available=False))) == []
+
+
+def test_compare_parity_exact_tier_floor():
+    base = _fresh(parity=_parity(exact_rate=0.02))
+    # within tolerance: pass
+    ok = _fresh(parity=_parity(exact_rate=0.0185))
+    assert cbr._compare_parity(ok, base, 0.20) == []
+    # exact tier regressed beyond the floor
+    slow = _fresh(parity=_parity(exact_rate=0.01))
+    got = cbr._compare_parity(slow, base, 0.20)
+    assert any("exact-tier throughput regression" in p for p in got)
+    # lost the section against a carrier
+    got = cbr._compare_parity(_fresh(), base, 0.20)
+    assert any("no parity section" in p for p in got)
+    # different shape/device gates nothing
+    other = _fresh(parity=_parity(exact_rate=0.001, rows=11_000_000))
+    assert cbr._compare_parity(other, base, 0.20) == []
+    # a baseline without the section gates nothing
+    assert cbr._compare_parity(ok, _fresh(), 0.20) == []
+
+
+def test_cli_parity_self_gate_and_floor(tmp_path):
+    """End-to-end through main(): a failing measured-parity artifact
+    exits 1 even against a trajectory that predates the section, and
+    the exact-tier floor gates against a carrier point."""
+    base_dir = tmp_path / "traj"
+    base_dir.mkdir()
+    (base_dir / "BENCH_r1.json").write_text(json.dumps(
+        _fresh(parity=_parity(exact_rate=0.02))))
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_fresh(parity=_parity(exact_rate=0.019))))
+    assert cbr.main([str(ok), "--baseline-dir", str(base_dir)]) == 0
+    bad_q = tmp_path / "bad_quality.json"
+    bad_q.write_text(json.dumps(
+        _fresh(parity=_parity(auc_delta=9e-4, ok=False))))
+    assert cbr.main([str(bad_q), "--baseline-dir",
+                     str(base_dir)]) == 1
+    # --schema-only must ALSO refuse a recorded quality miss: quick
+    # parity runs are metric-refused against the full trajectory, so
+    # schema-only is the mode that validates them
+    assert cbr.main([str(bad_q), "--schema-only"]) == 1
+    assert cbr.main([str(ok), "--schema-only"]) == 0
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(_fresh(parity=_parity(exact_rate=0.01))))
+    assert cbr.main([str(slow), "--baseline-dir", str(base_dir)]) == 1
+
+
+def test_cli_parity_walks_back_to_latest_carrier(tmp_path):
+    """A newer trajectory point that predates the parity section must
+    not mask the exact-tier floor of an older carrier."""
+    base_dir = tmp_path / "traj"
+    base_dir.mkdir()
+    (base_dir / "BENCH_r1.json").write_text(json.dumps(
+        _fresh(parity=_parity(exact_rate=0.02))))
+    (base_dir / "BENCH_r2.json").write_text(json.dumps(_fresh()))
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(_fresh(parity=_parity(exact_rate=0.01))))
+    assert cbr.main([str(slow), "--baseline-dir", str(base_dir)]) == 1
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_fresh(parity=_parity(exact_rate=0.02))))
+    assert cbr.main([str(ok), "--baseline-dir", str(base_dir)]) == 0
+
+
 def test_check_schema_slo_section():
     # a valid section passes; absence is fine too (old artifacts)
     assert cbr.check_schema(_fresh(slo=_slo_block())) == []

@@ -17,6 +17,14 @@ the numbers. This tool makes the comparison mechanical:
   shared hosts are far noisier than throughput) of the latest baseline
   that CARRIES the quantiles; trajectory points predating the field are
   skipped, never treated as a zero-latency baseline;
+- **measured parity** (``bench.py --parity``): the ``parity`` section
+  carries both tiers' measured walls/AUCs against reference LightGBM
+  CPU on the same data — the exact-semantics tier's throughput gates
+  like the headline (floor, ``--throughput-tol``, against the latest
+  trajectory point CARRYING a comparable parity section), and when the
+  reference was importable the per-tier AUC delta must stay under the
+  recorded ceiling (the reference's own ~4e-4 GPU-vs-CPU bar); a run
+  where the reference was unavailable must RECORD its skip reason;
 - **SLO section**: a fresh run carrying an ``slo`` section (obs/slo.py
   budget report: remaining error budget, burn rate, p99.9 tails) has
   its SHAPE validated — budget fields numeric-or-null, per-objective
@@ -173,6 +181,60 @@ def check_schema(fresh: dict) -> List[str]:
                 if not isinstance(lat.get(q), (int, float)):
                     problems.append(f"predict_latency.{q} missing/null")
     problems += _check_slo_schema(fresh.get("slo"))
+    problems += _check_parity_schema(fresh.get("parity"))
+    return problems
+
+
+def _check_parity_schema(parity) -> List[str]:
+    """Shape problems in the ``parity`` section (bench.py --parity):
+    both tiers must carry their measured numbers, and a run without
+    the reference must carry its skip reason — an artifact that
+    silently lost the measurement must not pass as "nothing to
+    check"."""
+    if parity is None:
+        return []
+    if not isinstance(parity, dict):
+        return [f"parity is {type(parity).__name__}, not a dict"]
+    problems = []
+    tiers = parity.get("tiers")
+    if not isinstance(tiers, dict):
+        problems.append("parity.tiers missing/not a dict")
+        tiers = {}
+    for tname in ("exact", "proxy"):
+        t = tiers.get(tname)
+        if not isinstance(t, dict):
+            problems.append(f"parity.tiers.{tname} missing/not a dict")
+            continue
+        for k in ("wall_s", "row_iters_per_s", "auc_tpu"):
+            v = t.get(k)
+            if not (isinstance(v, (int, float))
+                    and not isinstance(v, bool)):
+                problems.append(f"parity.tiers.{tname}.{k} "
+                                "missing/not numeric")
+    avail = parity.get("ref_available")
+    if not isinstance(avail, bool):
+        problems.append("parity.ref_available missing/not a bool")
+    elif avail:
+        for tname in ("exact", "proxy"):
+            t = tiers.get(tname)
+            if isinstance(t, dict):
+                for k in ("auc_ref", "auc_delta", "ref_wall_s"):
+                    v = t.get(k)
+                    if not (isinstance(v, (int, float))
+                            and not isinstance(v, bool)):
+                        problems.append(
+                            f"parity.tiers.{tname}.{k} missing/not "
+                            "numeric (reference was available)")
+    else:
+        if not (isinstance(parity.get("skip_reason"), str)
+                and parity["skip_reason"]):
+            problems.append("parity.skip_reason missing/empty with "
+                            "ref_available false — a skipped reference "
+                            "run must record why")
+    if not isinstance(parity.get("ok"), bool):
+        problems.append("parity.ok missing/not a bool")
+    if not isinstance(parity.get("auc_tol"), (int, float)):
+        problems.append("parity.auc_tol missing/not numeric")
     return problems
 
 
@@ -288,6 +350,87 @@ def compare(fresh: dict, baseline: dict,
     problems += _compare_latency(fresh, baseline, latency_tol)
     problems += _compare_lrb_stream(fresh, baseline, throughput_tol,
                                     staleness_slack)
+    problems += _compare_parity(fresh, baseline, throughput_tol)
+    return problems
+
+
+def parity_quality_problems(fresh: dict) -> List[str]:
+    """Fresh-run-only parity assertions (no baseline needed): when the
+    reference engine WAS measured, every tier's AUC must be inside the
+    run's recorded ceiling and the run's own ``ok`` verdict must hold —
+    a measured quality miss is a regression even on the very first
+    trajectory point that carries the section."""
+    parity = fresh.get("parity")
+    if not isinstance(parity, dict):
+        return []
+    problems = []
+    if parity.get("ok") is False:
+        problems.append("parity.ok is false: the run's own measured "
+                        "AUC-parity assertion failed")
+    if parity.get("ref_available") is not True:
+        return problems
+    tol = parity.get("auc_tol")
+    if not isinstance(tol, (int, float)):
+        return problems
+    for tname, t in (parity.get("tiers") or {}).items():
+        if not isinstance(t, dict):
+            continue
+        d = t.get("auc_delta")
+        if isinstance(d, (int, float)) and d > tol:
+            problems.append(
+                f"measured-parity regression: {tname} tier AUC delta "
+                f"{d:g} vs reference exceeds the {tol:g} ceiling")
+    return problems
+
+
+def _parity_comparable(fresh: dict, baseline: dict) -> bool:
+    """True when the baseline's parity block can gate this fresh run:
+    it exists and its workload shape (rows/iters/leaves/bins +
+    device kind) matches — an exact-tier floor measured on a different
+    shape or device gates nothing."""
+    bp = baseline.get("parity")
+    if not isinstance(bp, dict):
+        return False
+    fp = fresh.get("parity")
+    if not isinstance(fp, dict):
+        return True          # lost-section check still applies
+    keys = ("rows", "iters", "leaves", "max_bin", "device_kind")
+    return all(bp.get(k) == fp.get(k) for k in keys)
+
+
+def _compare_parity(fresh: dict, baseline: dict,
+                    throughput_tol: float) -> List[str]:
+    """Measured-parity gate: the EXACT-semantics tier's throughput is
+    a floor (like the headline value, ``--throughput-tol``) against
+    the latest baseline carrying a comparable parity section — the
+    whole point of the section is that the exact tier's speed stops
+    being invisible behind the proxy-tier headline. Only fires when
+    the baseline carries it; a fresh run that LOST the section against
+    a carrier is itself a problem."""
+    bp = baseline.get("parity")
+    if not isinstance(bp, dict):
+        return []
+    if not _parity_comparable(fresh, baseline):
+        return []
+    fp_raw = fresh.get("parity")
+    if not isinstance(fp_raw, dict):
+        return ["fresh run carries no parity section to compare"]
+    problems = []
+    bt = ((bp.get("tiers") or {}).get("exact") or {})
+    brate = bt.get("row_iters_per_s")
+    if isinstance(brate, (int, float)):
+        ft = ((fp_raw.get("tiers") or {}).get("exact") or {})
+        frate = ft.get("row_iters_per_s")
+        if not isinstance(frate, (int, float)):
+            problems.append("fresh run carries no parity.tiers.exact."
+                            "row_iters_per_s to compare")
+        else:
+            floor = (1.0 - throughput_tol) * brate
+            if frate < floor:
+                problems.append(
+                    f"exact-tier throughput regression: {frate:g} "
+                    f"M row-iters/s < {floor:g} (baseline {brate:g} - "
+                    f"{throughput_tol:.0%})")
     return problems
 
 
@@ -436,6 +579,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     for note in field_notes(fresh):
         print(f"NOTE: {note}")
+    # fresh-only measured-parity assertions: a run that measured the
+    # reference and missed the AUC ceiling fails regardless of the
+    # trajectory (there is nothing to walk back to — the miss is a
+    # fact of this run). Checked BEFORE the --schema-only early
+    # return: quick-shape parity runs are metric-refused against the
+    # full-size trajectory, so schema-only is exactly the mode that
+    # validates them — it must not wave a recorded quality miss
+    # through.
+    quality = parity_quality_problems(fresh)
+    if quality:
+        for p in quality:
+            print(f"REGRESSION (self): {p}", file=sys.stderr)
+        return 1
     if args.schema_only:
         print(f"schema ok: {args.fresh} "
               f"({fresh['value']:g} {fresh['unit']})")
@@ -466,6 +622,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                 got = _compare_lrb_stream(fresh, cand,
                                           args.throughput_tol,
                                           args.staleness_slack)
+                if got:
+                    problems = got
+                    baseline_name = os.path.basename(p)
+                break
+    # same walk-back for the parity section: gate the exact-tier floor
+    # against the latest same-workload point CARRYING a comparable
+    # parity block (newer points that predate it gate nothing)
+    if not problems and not _parity_comparable(fresh, baseline):
+        for p in reversed(points[:-1]):
+            cand = load_bench(p)
+            if (cand.get("metric") == fresh.get("metric")
+                    and _parity_comparable(fresh, cand)):
+                got = _compare_parity(fresh, cand,
+                                      args.throughput_tol)
                 if got:
                     problems = got
                     baseline_name = os.path.basename(p)
