@@ -558,6 +558,27 @@ class Config:
     # reassociates sums, so final-ulp histogram drift vs the dense
     # tier is possible (documented in docs/Design.md §5f).
     tpu_sparse: int = -1
+    # multi-host cluster bootstrap (parallel/cluster.py): number of
+    # JAX PROCESSES forming the training cluster. The reference's
+    # num_machines counts socket peers on its TCP linkers; this counts
+    # jax.distributed processes whose devices form ONE global mesh.
+    # 0/1 = single-process (the virtual mesh path). Env twin
+    # LGBM_TPU_NUM_MACHINES (launchers) outranks the knob.
+    tpu_num_machines: int = 0
+    # this process's rank in [0, tpu_num_machines); -1 = take it from
+    # the LGBM_TPU_MACHINE_RANK env (how the drill launcher tells N
+    # otherwise-identical workers apart)
+    tpu_machine_rank: int = -1
+    # coordinator address host:port (rank 0's reachable address — the
+    # analog of the reference's machine_list first entry). Env twin
+    # LGBM_TPU_COORDINATOR. Required when tpu_num_machines > 1.
+    tpu_coordinator: str = ""
+    # bounded deadline for cross-process sync points (cluster barriers,
+    # the training-loop stall watchdog): a dead peer produces a
+    # one-line error naming the rank within this budget, never an
+    # indefinite hang. The spiritual successor of the reference's
+    # ``time_out`` socket knob (minutes there, seconds here).
+    tpu_collective_timeout_s: float = 60.0
 
     def __post_init__(self):
         self._raw_params: Dict[str, str] = {}
@@ -835,6 +856,25 @@ class Config:
             log.warning("tpu_sparse=%d is not one of -1/0/1; using -1 "
                         "(auto)", self.tpu_sparse)
             self.tpu_sparse = -1
+        if self.tpu_num_machines < 0:
+            log.warning("tpu_num_machines=%d is negative; using 0 "
+                        "(single process)", self.tpu_num_machines)
+            self.tpu_num_machines = 0
+        if self.tpu_machine_rank < -1:
+            log.warning("tpu_machine_rank=%d is below -1; using -1 "
+                        "(take the rank from LGBM_TPU_MACHINE_RANK)",
+                        self.tpu_machine_rank)
+            self.tpu_machine_rank = -1
+        if (self.tpu_num_machines > 1
+                and self.tpu_machine_rank >= self.tpu_num_machines):
+            log.fatal(f"tpu_machine_rank={self.tpu_machine_rank} is "
+                      f"outside [0, tpu_num_machines="
+                      f"{self.tpu_num_machines}) — every process needs "
+                      f"a distinct rank below the world size")
+        if self.tpu_collective_timeout_s <= 0:
+            log.warning("tpu_collective_timeout_s=%g is not positive; "
+                        "using 60.0", self.tpu_collective_timeout_s)
+            self.tpu_collective_timeout_s = 60.0
         if not 0.0 < self.sparse_threshold <= 1.0:
             # the CSR route gate (io/sparse.py route_sparse): the
             # implicit fraction must reach this threshold

@@ -107,26 +107,44 @@ def _allgather_mappers(local: List[Optional[BinMapper]]
     return out
 
 
+def _rank_queries(nq: int, rank: int, world: int,
+                  mode: str = "round_robin") -> np.ndarray:
+    """Query indices owned by ``rank`` — round_robin (the reference's
+    ``i % world`` default) or contiguous ceil(nq/world) blocks (the
+    elastic multi-host path: original row order is preserved, so the
+    shard-invariant row hashing of the quantized tier lines up with
+    the serial run)."""
+    if mode == "contiguous":
+        b = -(-nq // world) if world else nq
+        return np.arange(min(rank * b, nq), min((rank + 1) * b, nq))
+    return np.arange(rank, nq, world)
+
+
 def _rank_rows(n: int, rank: int, world: int,
-               query_boundaries: Optional[np.ndarray]) -> np.ndarray:
-    """Round-robin assignment (dataset_loader.cpp:163-167). With query
-    boundaries, whole QUERIES are assigned round-robin so no query is
-    split across hosts (the reference partitions by query when
-    boundaries exist, src/io/metadata.cpp CheckOrPartition)."""
+               query_boundaries: Optional[np.ndarray],
+               mode: str = "round_robin") -> np.ndarray:
+    """Row assignment (dataset_loader.cpp:163-167 round-robin;
+    ``mode="contiguous"`` = order-preserving blocks). With query
+    boundaries, whole QUERIES are assigned so no query is split across
+    hosts (the reference partitions by query when boundaries exist,
+    src/io/metadata.cpp CheckOrPartition)."""
     if query_boundaries is None:
-        return np.arange(rank, n, world)
+        # rows ARE size-1 queries: one assignment rule, two callers
+        return _rank_queries(n, rank, world, mode)
     nq = len(query_boundaries) - 1
-    qs = np.arange(rank, nq, world)
+    qs = _rank_queries(nq, rank, world, mode)
     return np.concatenate([
         np.arange(query_boundaries[q], query_boundaries[q + 1])
         for q in qs]) if len(qs) else np.zeros(0, np.int64)
 
 
 def _slice_metadata(meta: Metadata, sel: np.ndarray, n: int,
-                    rank: int, world: int) -> Metadata:
+                    rank: int, world: int,
+                    mode: str = "round_robin") -> Metadata:
     """Shard-slice every metadata field. init_score is the flattened
     [K*N] multiclass layout (io/loader.py) — sliced per class. Query
-    sizes are re-derived from the whole queries kept by _rank_rows."""
+    sizes are re-derived from the whole queries kept by _rank_rows
+    (same assignment ``mode``, so rows and groups cannot disagree)."""
     isc = meta.init_score
     if isc is not None:
         k = max(1, len(isc) // max(n, 1))
@@ -134,7 +152,7 @@ def _slice_metadata(meta: Metadata, sel: np.ndarray, n: int,
     group = None
     if meta.query_boundaries is not None:
         qb = meta.query_boundaries
-        qs = np.arange(rank, len(qb) - 1, world)
+        qs = _rank_queries(len(qb) - 1, rank, world, mode)
         group = np.diff(qb)[qs]
     return Metadata(
         label=None if meta.label is None else meta.label[sel],
@@ -168,31 +186,33 @@ class DistributedLoader:
     def _load_shard(self, X: np.ndarray, meta: Metadata,
                     categorical: Sequence[int], pre_partitioned: bool,
                     shard_matrices: Optional[List[np.ndarray]],
-                    names: Optional[List[str]] = None) -> TpuDataset:
-        """``X``/``meta`` are the full data (round-robin mode) or this
+                    names: Optional[List[str]] = None,
+                    mode: str = "round_robin") -> TpuDataset:
+        """``X``/``meta`` are the full data (shared-file mode) or this
         host's rows (pre-partitioned). ``shard_matrices`` = every rank's
         rows for emulated (one-process) agreement; None = the real
-        multi-process allgather.
+        multi-process allgather. ``mode`` picks the shared-file row
+        assignment (round_robin | contiguous).
 
         Each rank finds bins only for its OWNED columns (j % S == rank,
         the reference's workload split, dataset_loader.cpp:434-466);
         the exchange assembles the full agreed set."""
         X = np.asarray(X)
         nf = X.shape[1]
-        round_robin = not pre_partitioned and self.world > 1
-        if round_robin:
+        shared_file = not pre_partitioned and self.world > 1
+        if shared_file:
             sel = _rank_rows(X.shape[0], self.rank, self.world,
-                             meta.query_boundaries)
+                             meta.query_boundaries, mode)
             Xl = X[sel]
             ml = _slice_metadata(meta, sel, X.shape[0],
-                                 self.rank, self.world)
+                                 self.rank, self.world, mode)
             total = X.shape[0]
             if shard_matrices is None and self._emulated():
                 # shared data, one process: every rank's slice is in
                 # hand — true per-rank mappers, exact agreement
                 shard_matrices = [
                     X[_rank_rows(X.shape[0], r, self.world,
-                                 meta.query_boundaries)]
+                                 meta.query_boundaries, mode)]
                     for r in range(self.world)]
         else:
             Xl, ml = X, meta
@@ -232,21 +252,26 @@ class DistributedLoader:
     def load_rank_matrix(self, X: np.ndarray, metadata: Metadata,
                          categorical: Sequence[int] = (),
                          pre_partitioned: bool = False,
-                         all_shards: Optional[List[np.ndarray]] = None
-                         ) -> TpuDataset:
+                         all_shards: Optional[List[np.ndarray]] = None,
+                         contiguous: bool = False) -> TpuDataset:
         """Construct this rank's shard dataset from an in-memory matrix.
 
         pre_partitioned=True: ``X``/``metadata`` are ALREADY this host's
         rows (the reference's pre_partition=true file-per-machine mode).
         Otherwise rows (whole queries for ranking data) are assigned
         round-robin ``i % world == rank``
-        (dataset_loader.cpp:163-167 used_data_indices).
+        (dataset_loader.cpp:163-167 used_data_indices), or as
+        order-preserving contiguous blocks with ``contiguous=True``
+        (the elastic multi-host trainer's assignment — see
+        _rank_queries).
 
         ``all_shards`` supplies every shard's rows so the mapper
         exchange can be emulated without multiple processes.
         """
         return self._load_shard(X, metadata, categorical,
-                                pre_partitioned, all_shards)
+                                pre_partitioned, all_shards,
+                                mode=("contiguous" if contiguous
+                                      else "round_robin"))
 
     def load_rank_file(self, filename: str,
                        pre_partitioned: Optional[bool] = None,
@@ -272,3 +297,171 @@ class DistributedLoader:
         log.info("Distributed load rank %d/%d: %d local rows",
                  self.rank, self.world, ds.num_data)
         return ds
+
+    # -- real multi-process construction (parallel/cluster.py) ----------
+
+    def construct_multihost(self, X_local: np.ndarray,
+                            meta_global: Metadata, *, n_global: int,
+                            row_start: int, mesh,
+                            categorical: Sequence[int] = (),
+                            feature_names: Optional[List[str]] = None,
+                            mappers: Optional[List[BinMapper]] = None
+                            ) -> TpuDataset:
+        """Per-host ingest under a REAL multi-process mesh: this rank
+        holds only the contiguous global rows [row_start, row_start +
+        len(X_local)) (cut by io/ingest.host_row_block so host blocks
+        cover the mesh's device shard blocks), bin boundaries are
+        agreed over the real allgather wire (each rank finds its OWNED
+        columns' mappers from its LOCAL rows, exactly the reference's
+        distributed bin finding), and the [F, N_pad] bin matrix
+        assembles ACROSS processes — every host streams its block
+        through the double-buffered device ingest onto its own
+        devices; no host ever materializes (or transfers) the full
+        matrix.
+
+        The returned dataset is GLOBAL-shaped (``num_data=n_global``,
+        global metadata): models/gbdt.py keeps its host-side vectors
+        host-global under SPMD, and only the bins are row-sharded
+        device state. ``meta_global`` must carry full-length fields —
+        assemble per-host label files with ``allgather_row_slices``."""
+        X_local = np.asarray(X_local)
+        if X_local.dtype not in (np.float32, np.float64):
+            X_local = X_local.astype(np.float64)
+        nf = X_local.shape[1]
+        total = int(n_global)
+        if mappers is not None:
+            # externally-agreed boundaries (an elastic resume injects
+            # the checkpoint bundle's mappers): no bin finding, no
+            # exchange — every rank installs the same list
+            agreed = mappers
+        else:
+            # owned-column local mappers from LOCAL rows; the exchange
+            # assembles every rank's contribution (j % world owner
+            # rule)
+            local = find_column_mappers(
+                X_local, self.config, categorical, total,
+                columns=self._owned(self.rank, nf))
+            per_shard = _allgather_mappers(local)
+            if len(per_shard) != self.world:
+                log.fatal(f"multihost bin agreement saw "
+                          f"{len(per_shard)} processes, expected "
+                          f"{self.world} — every rank must construct "
+                          f"the dataset collectively")
+            agreed = shard_bin_mappers(per_shard)
+
+        ds = TpuDataset(self.config)
+        ds.num_data = total
+        ds.num_total_features = nf
+        ds.metadata = meta_global
+        ds.metadata.check_or_partition(total)
+        ds.feature_names = (list(feature_names) if feature_names
+                            else [f"Column_{i}" for i in range(nf)])
+        ds._set_mappers(agreed)
+
+        from .ingest import (DeviceBinner, IngestUnsupported,
+                             host_row_block, mappers_supported,
+                             shard_width)
+        binner = None
+        if ds.mappers and mappers_supported(ds.mappers):
+            try:
+                binner = DeviceBinner(ds.mappers, ds.used_feature_map,
+                                      self.config, X_local.dtype)
+            except IngestUnsupported as e:
+                log.debug("multihost device ingest unavailable (%s); "
+                          "host binner per block", e)
+        hist_chunk = int(getattr(self.config, "tpu_hist_chunk", 0) or 0)
+        lo, hi, S = host_row_block(total, mesh, hist_chunk)
+        if not (row_start <= lo and hi <= row_start + X_local.shape[0]):
+            raise ValueError(
+                f"rank {self.rank}: local rows [{row_start}, "
+                f"{row_start + X_local.shape[0]}) do not cover this "
+                f"host's device blocks [{lo}, {hi}) — cut per-host "
+                f"data with io/ingest.host_row_block")
+        if binner is not None:
+            ds.bins_t_dev = binner.bin_matrix_multihost(
+                X_local, mesh, total, row_start)
+        else:
+            # host-binner fallback: bin the local block on host, then
+            # assemble the same global layout from per-device shards
+            import jax
+            import jax.numpy as jnp
+            from ..parallel import cluster
+            from ..parallel.learners import AXIS
+            positions = list(mesh.devices.reshape(-1))
+            D = len(positions)
+            S = shard_width(total, D, hist_chunk)
+            dtype = (np.uint8 if ds.max_bin_global <= 256 else np.int32)
+            proc_shards = []
+            for gd, dev in enumerate(positions):
+                if dev.process_index != jax.process_index():
+                    continue
+                blk_lo, blk_hi = gd * S, min(gd * S + S, total)
+                blk = np.zeros((max(len(ds.mappers), 1), S), dtype)
+                if blk_lo < blk_hi:
+                    rows = ds.bin_rows(
+                        X_local[blk_lo - row_start:blk_hi - row_start])
+                    blk[:, :blk_hi - blk_lo] = rows.T
+                proc_shards.append(jax.device_put(jnp.asarray(blk),
+                                                  dev))
+            ds.bins_t_dev = cluster.local_shards_to_global(
+                proc_shards, (max(len(ds.mappers), 1), D * S), mesh,
+                None, AXIS)
+        ds.bins_t_dev_pad = ds.bins_t_dev.shape[1] - total
+        ds.bins = None
+        log.info("multihost load rank %d/%d: %d global rows, this "
+                 "host's block [%d, %d)", self.rank, self.world, total,
+                 lo, hi)
+        return ds
+
+
+def allgather_row_slices(values: Optional[np.ndarray], row_start: int,
+                         n_global: int) -> Optional[np.ndarray]:
+    """Assemble a GLOBAL row-aligned vector (labels, weights) from
+    every rank's contiguous slice over the coordination allgather —
+    how per-host label files become the host-global metadata
+    models/gbdt.py keeps under SPMD. None passes through (every rank
+    must agree it is None)."""
+    import jax
+    if jax.process_count() == 1:
+        return values
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    have = values is not None
+    flags = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([1 if have else 0], jnp.int32)))
+    if int(flags.sum()) == 0:
+        return None
+    if int(flags.sum()) != flags.size:
+        log.fatal("allgather_row_slices: some ranks passed None and "
+                  "others data — metadata fields must be consistently "
+                  "present across hosts")
+    v = np.asarray(values, np.float64).reshape(-1)
+    lens = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray([int(row_start), v.size], jnp.int32)))
+    maxlen = int(lens[:, 1].max())
+    # float64 rides the wire as BYTES: jnp.asarray of a float64 host
+    # buffer silently downcasts to float32 with x64 disabled (the
+    # same reason _allgather_mappers ships pickled uint8) — a direct
+    # gather would truncate every value
+    padded = np.zeros(maxlen * 8, np.uint8)
+    raw = np.frombuffer(v.tobytes(), np.uint8)
+    padded[:raw.size] = raw
+    gathered = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(padded)))
+    out = np.zeros(int(n_global), np.float64)
+    seen = np.zeros(int(n_global), bool)
+    for r in range(gathered.shape[0]):
+        lo, ln = int(lens[r, 0]), int(lens[r, 1])
+        vals = np.frombuffer(
+            gathered[r, :ln * 8].tobytes(), np.float64)
+        # host blocks may OVERLAP at shard-alignment boundaries
+        # (host_row_block clamps to n); last writer wins — the slices
+        # agree wherever they overlap by construction
+        out[lo:lo + ln] = vals
+        seen[lo:lo + ln] = True
+    if not seen.all():
+        log.fatal(f"allgather_row_slices: assembled slices leave "
+                  f"{int((~seen).sum())} of {n_global} rows uncovered "
+                  f"— per-host slices must tile [0, n_global)")
+    return out

@@ -113,6 +113,51 @@ def ingest_mesh(config):
     return training_mesh(config)
 
 
+def shard_width(n: int, D: int, hist_chunk: int = 0) -> int:
+    """Per-device row-shard width S for ``n`` global rows over ``D``
+    mesh devices: device (mesh position) gd owns global rows
+    [gd*S, (gd+1)*S). Each shard aligns to the grower's row chunk so
+    _setup_grower ADOPTS this padding instead of re-padding +
+    resharding the whole mesh-resident matrix: the pinned
+    tpu_hist_chunk when set, else the LARGEST power-of-two unit
+    u <= MAX_HIST_CHUNK (the autotune candidate ceiling, exhaustive
+    tier included) with n >= 4*D*u — the grower only chunk-aligns when
+    n >= 4*D*kchunk, so every kchunk it can align to satisfies
+    kchunk <= u and (both powers of two) divides S; pad stays <= S/4
+    by the same bound. ONE function for the single-process sharded
+    path, the multi-process per-host path, and the loader's host
+    row-block slicing (io/distributed.py) — their geometries cannot
+    drift."""
+    S = max(-(-int(n) // int(D)), 1)
+    from ..ops.autotune import MAX_HIST_CHUNK
+    if hist_chunk > 0:
+        u = hist_chunk if n >= 4 * D * hist_chunk else 1
+    else:
+        u = 1
+        while u * 2 <= MAX_HIST_CHUNK and n >= 4 * D * (u * 2):
+            u *= 2
+    if u > 1:
+        S = -(-S // u) * u
+    return S
+
+
+def host_row_block(n_global: int, mesh, hist_chunk: int = 0) -> tuple:
+    """(row_start, row_stop, S) — the contiguous GLOBAL row range this
+    process must hold so its addressable devices' shard blocks are
+    coverable by bin_matrix_multihost (row_stop clamps to n_global)."""
+    import jax
+    positions = list(mesh.devices.reshape(-1))
+    S = shard_width(n_global, len(positions), hist_chunk)
+    proc = jax.process_index()
+    owned = [gd for gd, dev in enumerate(positions)
+             if dev.process_index == proc]
+    if not owned:
+        return 0, 0, S
+    lo = min(owned) * S
+    hi = min((max(owned) + 1) * S, int(n_global))
+    return min(lo, int(n_global)), hi, S
+
+
 def mappers_supported(mappers: Sequence[BinMapper]) -> bool:
     """True when every mapper is reproducible on device: categorical
     tables must fit int32 (host matching runs at int64)."""
@@ -730,25 +775,7 @@ class DeviceBinner:
         D = len(devs)
         n = X.shape[0]
         C = self.chunk_rows
-        S = max(-(-n // D), 1)
-        # align each shard to the grower's row chunk so _setup_grower
-        # ADOPTS this padding instead of re-padding + resharding the
-        # whole mesh-resident matrix: the pinned tpu_hist_chunk when
-        # set, else the LARGEST power-of-two unit u <= MAX_HIST_CHUNK
-        # (the autotune candidate ceiling, exhaustive tier included)
-        # with n >= 4*D*u — the grower only chunk-aligns when
-        # n >= 4*D*kchunk, so every kchunk it can align to satisfies
-        # kchunk <= u and (both powers of two) divides S; pad stays
-        # <= S/4 by the same bound
-        from ..ops.autotune import MAX_HIST_CHUNK
-        if self.hist_chunk > 0:
-            u = self.hist_chunk if n >= 4 * D * self.hist_chunk else 1
-        else:
-            u = 1
-            while u * 2 <= MAX_HIST_CHUNK and n >= 4 * D * (u * 2):
-                u *= 2
-        if u > 1:
-            S = -(-S // u) * u
+        S = shard_width(n, D, self.hist_chunk)
 
         # interleaved (device, row-slice) submission order: chunk k of
         # every shard before chunk k+1 of any — the round-robin that
@@ -792,6 +819,96 @@ class DeviceBinner:
         log.debug("sharded device ingest: %d rows x %d features over "
                   "%d device(s) (%d-row shards, %d-row chunks)",
                   n, len(self.mappers), D, S, C)
+        return bins_t
+
+    def bin_matrix_multihost(self, X_local: np.ndarray, mesh,
+                             n_global: int, row_start: int):
+        """Per-host half of a MULTI-PROCESS sharded ingest: this host
+        streams only its own contiguous row block through the
+        double-buffered pipeline onto its ADDRESSABLE devices, and the
+        global [F, N_pad] bin matrix assembles across processes via
+        ``make_array_from_single_device_arrays`` — no rank ever holds
+        (or transfers) another rank's rows. The device->row-block map
+        is IDENTICAL to ``bin_matrix_sharded``'s (global mesh position
+        gd owns global rows [gd*S, (gd+1)*S)), so a single-process
+        mesh and a multi-process mesh of the same size produce the
+        same global layout bit-for-bit.
+
+        ``X_local`` holds global rows [row_start, row_start + len)
+        and must cover every block owned by this process's devices
+        (parallel/elastic.py's loader slices exactly that).
+        """
+        import jax
+        from ..parallel import cluster
+        from ..parallel.learners import AXIS
+
+        positions = list(mesh.devices.reshape(-1))
+        D = len(positions)
+        n = int(n_global)
+        C = self.chunk_rows
+        S = shard_width(n, D, self.hist_chunk)
+
+        proc = jax.process_index()
+        local = [(gd, dev) for gd, dev in enumerate(positions)
+                 if dev.process_index == proc]
+        n_local = X_local.shape[0]
+        for gd, _ in local:
+            lo = gd * S
+            hi = min(lo + S, n)
+            if lo < hi and not (row_start <= lo
+                                and hi <= row_start + n_local):
+                raise ValueError(
+                    f"multihost ingest: rank's rows [{row_start}, "
+                    f"{row_start + n_local}) do not cover device "
+                    f"{gd}'s block [{lo}, {hi}) — slice per-host data "
+                    f"with elastic.host_row_block so host and device "
+                    f"blocks line up")
+
+        # interleaved (device, chunk) submission across the LOCAL
+        # devices — the same round-robin overlap as the single-process
+        # sharded path, per host
+        tasks = []     # (local index, global row start, rows)
+        max_chunks = -(-S // C)
+        for k in range(max_chunks):
+            for li, (gd, _) in enumerate(local):
+                r0 = gd * S + k * C
+                r1 = min(gd * S + S, n, r0 + C)
+                if r0 < min(gd * S + S, n):
+                    tasks.append((li, r0, r1 - r0))
+
+        def thunk(t):
+            li, r0, rows = t
+            lo = r0 - row_start
+            return lambda: (li, self._prep_chunk(
+                X_local[lo:lo + rows]))
+
+        per_dev = [[] for _ in local]
+        for prepped in prefetch((thunk(t) for t in tasks),
+                                what="multihost ingest chunk",
+                                policy=self.retry_policy):
+            li, p = prepped
+            per_dev[li].append(self._submit(p, device=local[li][1]))
+
+        import jax.numpy as jnp
+        shards = []
+        for li, (gd, dev) in enumerate(local):
+            rows_d = max(min(S, n - gd * S), 0)
+            parts = per_dev[li]
+            if rows_d < S:
+                parts.append(jax.device_put(
+                    jnp.zeros((len(self.mappers), S - rows_d),
+                              self.out_dtype), dev))
+            shards.append(parts[0] if len(parts) == 1
+                          else jnp.concatenate(parts, axis=1))
+        bins_t = cluster.local_shards_to_global(
+            shards, (len(self.mappers), D * S), mesh, None, AXIS)
+        obs.counter("ingest/rows_local_host").add(
+            sum(min(S, max(n - gd * S, 0)) for gd, _ in local))
+        log.info("multihost device ingest: rank %d/%d binned %d of %d "
+                 "global rows onto %d local device(s) (%d-row shards)",
+                 cluster.rank(), cluster.world(),
+                 sum(min(S, max(n - gd * S, 0)) for gd, _ in local),
+                 n, len(local), S)
         return bins_t
 
     def start_stream(self) -> "IngestStream":

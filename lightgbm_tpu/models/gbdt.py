@@ -146,6 +146,13 @@ class GBDT:
         # tpu_faults knob arms the recovery drills' injection points
         from ..utils import faults
         faults.configure_from_config(config)
+        # multi-host cluster (parallel/cluster.py): adopt an already-
+        # initialized jax.distributed runtime (the elastic worker
+        # bootstraps BEFORE dataset construction; embedders may too) so
+        # the placement seams below know the mesh spans processes.
+        # Single-process runs return immediately.
+        from ..parallel import cluster
+        cluster.initialize_from_config(config)
         self.objective = objective
         self.training_metrics = list(training_metrics)
         self.iter_ = 0
@@ -542,7 +549,7 @@ class GBDT:
                 self._pad_rows = (-self._n) % (D * kchunk)
             ing = getattr(self.train_data, "bins_t_dev_pad", 0)
             if ing > self._pad_rows:
-                unit = (D * kchunk if self._n >= 4 * D * kchunk else D)
+                unit = step_cache.shard_align_unit(self._n, D, kchunk)
                 if (self._n + ing) % unit == 0:
                     # sharded ingest already padded wider (32k-aligned
                     # shards) AND its width satisfies this learner's
@@ -558,7 +565,7 @@ class GBDT:
         # score width must stay a multiple of it (even shards for the
         # data/voting learners, chunk-aligned rows for the TPU kernels)
         if mode in ("data", "voting"):
-            unit = D * kchunk if self._n >= 4 * D * kchunk else D
+            unit = step_cache.shard_align_unit(self._n, D, kchunk)
         elif mode == "serial":
             from ..utils.device import on_tpu
             unit = kchunk if on_tpu() else 1
@@ -765,20 +772,52 @@ class GBDT:
         spec = tuple(AXIS if s == "rows" else None for s in spec)
         return NamedSharding(self._mesh, PartitionSpec(*spec))
 
+    def _multiprocess_mesh(self) -> bool:
+        """True when the training mesh spans >1 OS process (real
+        multi-host run, parallel/cluster.py): device_put cannot reach
+        non-addressable devices, so every placement below switches to
+        the global-array constructors. Host-side inputs stay
+        HOST-GLOBAL (every rank passes the same full-length value —
+        labels, masks, scores), which is what makes the seams the only
+        multi-process-aware code in this class."""
+        from ..parallel import cluster
+        return cluster.spans_processes(getattr(self, "_mesh", None))
+
+    def _global_put(self, x, *spec):
+        from ..parallel import cluster
+        from ..parallel.learners import AXIS
+        return cluster.host_to_global(
+            x, self._mesh, *tuple(AXIS if s == "rows" else None
+                                  for s in spec))
+
     def _place_rows(self, x):
         """[N_total] row vector onto the mesh (P over rows), or the
         default device for serial."""
         if not self._row_sharded():
             return jnp.asarray(x)
+        if self._multiprocess_mesh():
+            return self._global_put(x, "rows")
         return jax.device_put(x, self._named_sharding("rows"))
 
     def _place_bins(self, x):
         """[F, N_total] bin matrix: feature axis replicated, row axis
         sharded. device_put of a host matrix distributes each shard
         straight to its chip; re-placing an already-matching sharded
-        array (the sharded-ingest path) is a no-op."""
+        array (the sharded-ingest path) is a no-op. Under a
+        multi-process mesh the matrix is REQUIRED to already be the
+        multihost-assembled global array (io/ingest.py
+        bin_matrix_multihost) — no single host holds the full matrix
+        to place."""
         if not self._row_sharded():
             return jnp.asarray(x)
+        if self._multiprocess_mesh():
+            if not hasattr(x, "sharding"):
+                raise ValueError(
+                    "multi-process training needs the bin matrix "
+                    "assembled by the multihost ingest "
+                    "(io/distributed.py construct_multihost) — a host "
+                    "matrix cannot be placed across processes")
+            return x
         return jax.device_put(x, self._named_sharding(None, "rows"))
 
     def _place_scores(self, x):
@@ -793,6 +832,9 @@ class GBDT:
         if (not self._row_sharded()
                 or np.shape(x)[-1] % self.num_devices):
             return jnp.asarray(x)
+        if self._multiprocess_mesh():
+            return (x if hasattr(x, "sharding")
+                    else self._global_put(x, None, "rows"))
         return jax.device_put(x, self._named_sharding(None, "rows"))
 
     def _place_step_rows(self, x):
@@ -804,6 +846,8 @@ class GBDT:
                 or x.shape[-1] % self.num_devices):
             return jnp.asarray(x)
         spec = ("rows",) if x.ndim == 1 else (None, "rows")
+        if self._multiprocess_mesh():
+            return self._global_put(x, *spec)
         return jax.device_put(x, self._named_sharding(*spec))
 
     def _parse_forced_splits(self) -> tuple:
@@ -1365,6 +1409,13 @@ class GBDT:
         return self._train_one_iter_inner(grad, hess)
 
     def _train_one_iter_inner(self, grad, hess) -> bool:
+        from ..parallel import cluster
+        if cluster.is_multiprocess():
+            # progress stamp for the no-hang watchdog
+            # (cluster.DeadlineGuard): a peer death that BLOCKS a
+            # collective instead of failing it is detected as a stall
+            # at this label within tpu_collective_timeout_s
+            cluster.tick(f"iteration {self.iter_ + 1}")
         K = self.num_tree_per_iteration
         init_scores = [0.0] * K
         custom = grad is not None and hess is not None

@@ -120,6 +120,21 @@ def bucket_rows(n: int, align: int = 1, policy: Optional[int] = None) -> int:
     return _round_up(pow2_bucket(n, MIN_BUCKET), align)
 
 
+def shard_align_unit(n: int, D: int, kchunk: int) -> int:
+    """Row-alignment unit of a D-device row-sharding learner
+    (data/voting): shards chunk-align only when the data is large
+    enough that the pad stays small (n >= 4*D*kchunk), else they
+    align to the device count alone. The bucketed score width must be
+    a multiple of this. ONE function for the grower's padding
+    (models/gbdt.py _setup_grower) and the elastic-resume geometry
+    (utils/checkpoint.py): resuming a checkpoint onto a DIFFERENT
+    world size re-buckets the row block to the new world's unit —
+    ``bucket_rows(n, shard_align_unit(n, D_new, kchunk), policy)`` IS
+    the new shard width, and whether the transition is score-shape
+    preserving is exactly whether old and new widths agree."""
+    return D * kchunk if n >= 4 * D * kchunk else D
+
+
 def pow2_bucket(x: int, floor: int) -> int:
     """THE shared shape-taper every bucketing discipline uses (score
     rows, sparse nnz planes, ingest entry planes): next power of two

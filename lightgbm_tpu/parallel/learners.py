@@ -99,9 +99,22 @@ _meshes_logged: set = set()
 
 def make_mesh(num_devices: Optional[int] = None) -> Mesh:
     from ..utils import log
-    from ..utils.device import get_devices
-    devs = get_devices()
-    n = len(devs) if num_devices is None else min(num_devices, len(devs))
+    from ..utils.device import get_devices, get_global_devices
+    if jax.process_count() > 1:
+        # real multi-process cluster (parallel/cluster.py): the mesh
+        # MUST span every process's devices — a psum over a subset
+        # would leave the excluded ranks' programs waiting forever, so
+        # per-caller device caps (num_machines) do not apply here
+        devs = get_global_devices()
+        if num_devices is not None and num_devices < len(devs):
+            log.debug("multi-process mesh ignores the %d-device cap: "
+                      "collectives must span all %d global devices",
+                      num_devices, len(devs))
+        n = len(devs)
+    else:
+        devs = get_devices()
+        n = (len(devs) if num_devices is None
+             else min(num_devices, len(devs)))
     kind = str(getattr(devs[0], "device_kind", None) or devs[0].platform)
     # one info line per distinct mesh per process (ingest + grower +
     # every CV fold all build the same mesh; size-1 meshes are about
@@ -109,7 +122,8 @@ def make_mesh(num_devices: Optional[int] = None) -> Mesh:
     emit = log.info if n > 1 and (n, kind) not in _meshes_logged \
         else log.debug
     _meshes_logged.add((n, kind))
-    emit("mesh built: %d device(s) of kind %s on axis %r", n, kind, AXIS)
+    emit("mesh built: %d device(s) of kind %s on axis %r (%d process(es))",
+         n, kind, AXIS, jax.process_count())
     return Mesh(np.asarray(devs[:n]), (AXIS,))
 
 

@@ -84,6 +84,12 @@ VOLATILE_KNOBS = frozenset({
     "tpu_resume_from", "tpu_faults", "tpu_fault_seed",
     "tpu_retry_attempts",
     "tpu_reqlog", "tpu_reqlog_sample", "tpu_slo", "tpu_flight_buffer",
+    # cluster topology (parallel/cluster.py): ELASTIC resume is the
+    # whole point — a checkpoint written by a 4-process run must
+    # restore under 2 processes (or 1) without a fingerprint refusal,
+    # and every process carries its own rank
+    "tpu_num_machines", "tpu_machine_rank", "tpu_coordinator",
+    "tpu_collective_timeout_s",
 })
 
 
@@ -142,6 +148,34 @@ def prune_checkpoints(directory: str, keep: int) -> None:
                 os.unlink(p)
             except OSError:
                 pass
+
+
+def mapper_fingerprint(mappers) -> str:
+    """Short sha256 over the serialized bin mappers — restore refuses
+    a dataset binned differently from the checkpointed run (device
+    TreeRecords are rebuilt from model text THROUGH the resuming
+    dataset's mappers, so silently different boundaries would shift
+    every restored threshold)."""
+    blob = json.dumps([m.to_dict() for m in mappers], sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def mappers_from_bundle(bundle: dict):
+    """The checkpointed run's bin mappers as a FULL per-real-column
+    list (trivial placeholders on unused columns), ready for
+    ``construct_from_matrix(mappers=...)`` — how an elastic resume
+    onto a different world size reconstructs the EXACT binning of the
+    original run (parallel/elastic.py). None when the bundle predates
+    the mapper record."""
+    rec = bundle.get("mappers")
+    if not rec:
+        return None
+    from ..io.binning import BinMapper
+    used = [int(j) for j in rec["used"]]
+    full = [BinMapper() for _ in range(int(rec["num_total_features"]))]
+    for j, d in zip(used, rec["mappers"]):
+        full[j] = BinMapper.from_dict(d)
+    return full
 
 
 # -- state gather/apply (the GBDT-private inventory) -------------------------
@@ -262,6 +296,7 @@ def save_checkpoint(booster, directory: str,
     loop) downgrades that to a warning so a full disk never takes
     training down, and the atomic writes guarantee the previous
     complete checkpoint survives."""
+    from ..parallel import cluster
     eff = booster._effective_num_models()
     if eff != len(booster.models):
         # trailing splitless trees: serialization would trim them while
@@ -273,6 +308,17 @@ def save_checkpoint(booster, directory: str,
         return None
     it = booster.current_iteration
     path = checkpoint_path(directory, it)
+    faults.check("checkpoint.write", context=f"iteration {it}")
+    # the gather is COLLECTIVE under a multi-process mesh (sharded
+    # score buffers all-gather to every host) — all ranks must reach
+    # it; only rank 0 then serializes anything or touches the
+    # filesystem (bundle construction below is host-local work the
+    # other ranks would discard)
+    arrays = {"scores": cluster.fetch(booster._scores)}
+    for vi, vs in enumerate(booster._valid_scores):
+        arrays[f"valid_{vi}"] = cluster.fetch(vs)
+    if cluster.rank() != 0:
+        return None
     bundle = {
         "schema": CHECKPOINT_SCHEMA,
         "version": CHECKPOINT_VERSION,
@@ -281,14 +327,39 @@ def save_checkpoint(booster, directory: str,
         "config_hash": config_fingerprint(booster.config),
         "parameters": booster.config.to_string(),
         "geometry": _geometry_summary(booster),
+        # world-size awareness (elastic resume): the score buffers
+        # above are GLOBAL and in original row order regardless of how
+        # many processes trained, so a different-size cluster can
+        # re-shard them (restore's elastic path). n_real is the true
+        # row count; columns past it are bucket/shard pad.
+        "world": {
+            "processes": cluster.world(),
+            "devices": booster.num_devices,
+            "n_real": int(getattr(booster, "_n", 0)),
+            "n_score": int(getattr(booster, "_n_score", 0)),
+            # per-valid-set true row counts: the elastic path needs
+            # them to re-shard valid buffers whose widths (like the
+            # train width) depend on the world size
+            "valid_n_real": [int(v.num_data) for v in
+                             getattr(booster, "valid_sets", [])],
+        },
         "state": gather_state(booster),
+        # the run's agreed bin mappers: an elastic resume constructs
+        # its dataset with EXACTLY these (mappers_from_bundle), and
+        # restore refuses a dataset binned differently (see
+        # mapper_fingerprint)
+        "mappers": {
+            "used": [int(j) for j in
+                     booster.train_data.used_feature_map],
+            "num_total_features": int(
+                booster.train_data.num_total_features),
+            "mappers": [m.to_dict()
+                        for m in booster.train_data.mappers],
+            "hash": mapper_fingerprint(booster.train_data.mappers),
+        },
         "scores_file": os.path.basename(scores_path(path)),
         "model": booster.model_to_string(),
     }
-    faults.check("checkpoint.write", context=f"iteration {it}")
-    arrays = {"scores": np.asarray(booster._scores)}
-    for vi, vs in enumerate(booster._valid_scores):
-        arrays[f"valid_{vi}"] = np.asarray(vs)
     with atomic_write(scores_path(path), mode="wb") as fh:
         np.savez_compressed(fh, **arrays)
     with atomic_write(path) as fh:
@@ -388,6 +459,17 @@ def restore(booster, bundle: dict) -> int:
             f"identical training parameters — diff the checkpoint's "
             f"'parameters' block against your run, or point "
             f"tpu_checkpoint_dir at a fresh directory to start over")
+    mrec = bundle.get("mappers")
+    if mrec and mrec.get("hash"):
+        have_h = mapper_fingerprint(booster.train_data.mappers)
+        if have_h != mrec["hash"]:
+            raise ValueError(
+                f"checkpoint was binned with different bin mappers "
+                f"(hash {mrec['hash']} vs this dataset's {have_h}) — "
+                f"restored tree thresholds would shift; construct the "
+                f"resuming dataset with the checkpoint's mappers "
+                f"(utils/checkpoint.mappers_from_bundle — the elastic "
+                f"driver parallel/elastic.py does this automatically)")
     scratch = GBDT()
     scratch.load_model_from_string(bundle["model"],
                                    source="checkpoint model text")
@@ -400,6 +482,7 @@ def restore(booster, bundle: dict) -> int:
             f"run's {K} (num_class/objective changed?)")
 
     # score buffers: the live device state, not a replay
+    from ..parallel import cluster
     spath = bundle.get("_scores_path") or bundle.get("scores_file")
     try:
         with np.load(spath) as z:
@@ -409,15 +492,67 @@ def restore(booster, bundle: dict) -> int:
     except (OSError, KeyError, ValueError) as e:
         raise ValueError(f"{spath}: unusable score sidecar "
                          f"({type(e).__name__}: {e})") from e
-    want_shape = tuple(np.shape(np.asarray(booster._scores)))
+    want_shape = tuple(np.shape(booster._scores))
     if tuple(scores.shape) != want_shape:
-        raise ValueError(
-            f"{spath}: score buffer shape {tuple(scores.shape)} does "
-            f"not match this run's {want_shape} — same data and "
-            f"tpu_row_bucket policy are required to resume")
+        wrec = bundle.get("world") or {}
+        old_world = wrec.get("processes")
+        n_real = int(wrec.get("n_real", 0) or 0)
+        new_world = cluster.world()
+        if (n_real and n_real == int(getattr(booster, "_n", 0))
+                and scores.shape[0] == want_shape[0]
+                and scores.shape[1] >= n_real
+                and want_shape[1] >= n_real):
+            # ELASTIC RE-SHARD (ops/step_cache.py shard_align_unit):
+            # same data, different world — the score width is just the
+            # row bucket for the new shard geometry. Real rows copy
+            # verbatim (bit-identity for everything the step reads);
+            # the pad region keeps this run's fresh-init values — pad
+            # scores are write-only (rvalid zeroes their gradients and
+            # nothing downstream reads them).
+            fresh = np.array(cluster.fetch(booster._scores))
+            fresh[:, :n_real] = scores[:, :n_real]
+            scores = fresh
+            log.info("elastic resume: re-sharded checkpoint scores "
+                     "from world=%s (%s devices, width %d) onto "
+                     "world=%d (%d devices, width %d) — %d real rows "
+                     "carried verbatim", old_world,
+                     wrec.get("devices", "?"),
+                     int(wrec.get("n_score", 0) or 0) or -1,
+                     new_world, booster.num_devices, want_shape[1],
+                     n_real)
+        elif old_world is not None and int(old_world) != new_world:
+            raise ValueError(
+                f"{spath}: checkpoint was written by a "
+                f"{old_world}-process run (score width "
+                f"{scores.shape[1]}) and this run has {new_world} "
+                f"process(es) (width {want_shape[1]}) over a "
+                f"different row count — elastic re-shard needs the "
+                f"SAME training data (same rows in the same order); "
+                f"re-point tpu_resume_from at a checkpoint of this "
+                f"dataset or retrain from scratch")
+        else:
+            raise ValueError(
+                f"{spath}: score buffer shape {tuple(scores.shape)} "
+                f"does not match this run's {want_shape} — same data "
+                f"and tpu_row_bucket policy are required to resume")
+    vreal = [int(x) for x in
+             (bundle.get("world") or {}).get("valid_n_real", [])]
     for vi, v in enumerate(valids):
-        have_v = tuple(np.shape(np.asarray(booster._valid_scores[vi])))
+        have_v = tuple(np.shape(booster._valid_scores[vi]))
         if tuple(v.shape) != have_v:
+            nv = vreal[vi] if vi < len(vreal) else 0
+            same_rows = (nv and vi < len(booster.valid_sets)
+                         and nv == int(booster.valid_sets[vi].num_data)
+                         and v.shape[0] == have_v[0]
+                         and v.shape[1] >= nv and have_v[1] >= nv)
+            if same_rows:
+                # same elastic rule as the train buffer: real rows
+                # verbatim, pad keeps this run's fresh-init values
+                fresh_v = np.array(cluster.fetch(
+                    booster._valid_scores[vi]))
+                fresh_v[:, :nv] = v[:, :nv]
+                valids[vi] = fresh_v
+                continue
             raise ValueError(
                 f"{spath}: valid_{vi} score shape {tuple(v.shape)} "
                 f"does not match this run's {have_v} — add the same "

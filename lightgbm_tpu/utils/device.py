@@ -34,6 +34,19 @@ def get_devices(platform: Optional[str] = None) -> List:
     return jax.devices()
 
 
+def get_global_devices(platform: Optional[str] = None) -> List:
+    """EVERY process's devices of the selected backend — the device
+    set a multi-process training mesh must span (a collective over a
+    subset would leave peers waiting forever). Single-process this is
+    exactly get_devices(); under jax.distributed the platform pin
+    routes through jax.devices(backend), which is global."""
+    plat = (platform or os.environ.get("LGBM_TPU_PLATFORM")
+            or _config_platform)
+    if jax.process_count() == 1:
+        return get_devices(plat)
+    return jax.devices(plat) if plat else jax.devices()
+
+
 def on_tpu() -> bool:
     """True when framework computation actually runs on a TPU device —
     gates Pallas kernel dispatch (Pallas TPU kernels can't lower for the

@@ -12,9 +12,11 @@ decision in the obs registry (``retry/attempts``, ``retry/retries``,
 Prometheus export instead of buried in logs.
 
 Classification is conservative: only errors that *say* they are
-transient (the grpc/absl status strings above, stdlib connection
-timeouts, or an injected ``InjectedFault(transient=True)`` from
-utils/faults.py) are retried — a genuine bug fails fast on attempt 1.
+transient (the grpc/absl status strings above, the jax.distributed /
+DCN bootstrap strings — coordinator connect refused, barrier timeout,
+heartbeat loss — stdlib connection timeouts, or an injected
+``InjectedFault(transient=True)`` from utils/faults.py) are retried —
+a genuine bug fails fast on attempt 1.
 
 Stdlib + obs only; importing this module never touches jax.
 """
@@ -36,6 +38,19 @@ TRANSIENT_MARKERS = (
     "ABORTED",
     "Connection reset",
     "Socket closed",
+    # jax.distributed / DCN bootstrap blips (parallel/cluster.py): a
+    # coordinator that is still binding its port, restarting after a
+    # preemption, or mid-handshake surfaces these — worth backoff, not
+    # an attempt-1 giveup. Kept SPECIFIC (full service/phrase strings),
+    # so a genuine config error ("connection" in some unrelated text)
+    # still fails fast.
+    "Connection refused",               # coordinator not listening yet
+    "failed to connect to all addresses",   # grpc channel not up
+    "Barrier timed out",                # peers still arriving
+    "heartbeat timeout",                # coordination-service blip
+    "Heartbeat timeout",
+    "coordination service",             # service restarting
+    "Coordination service",
 )
 
 

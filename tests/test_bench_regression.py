@@ -526,6 +526,90 @@ def test_slo_violations_are_notes_not_gates():
     assert len(got) == 1 and got[0].startswith("not comparable")
 
 
+# -- multichip elastic-drill artifacts (MULTICHIP_r06+) ----------------------
+
+def _drill_doc(**kw):
+    d = {
+        "schema": cbr.MULTICHIP_DRILL_SCHEMA, "version": 1,
+        "drill": "elastic_resume",
+        "workload": {"n": 2048, "f": 8, "iterations": 8},
+        "world_sizes": {"train": 2, "resume": 1},
+        "kill": {"rank": 1, "iteration": 5, "survivor_exit_code": 17,
+                 "survivor_error": "rank 1 of 2 unresponsive",
+                 "survivor_named_ranks": [1]},
+        "resume": {"from_iteration": 4, "total_iterations": 8},
+        "per_host_ingest_rows": [1024, 1024],
+        "model_parity": True, "parity_kind": "bit_identical",
+        "train_auc": 0.98, "resumed_auc": 0.98,
+        "wall_s": {"uninterrupted": 20.0},
+    }
+    d.update(kw)
+    return d
+
+
+def test_multichip_drill_pass_and_cli(tmp_path):
+    schema, regressions, notes = cbr.check_multichip_drill(_drill_doc())
+    assert schema == [] and regressions == []
+    assert any("ingest" in n for n in notes)
+    p = tmp_path / "drill.json"
+    p.write_text(json.dumps(_drill_doc()))
+    assert cbr.main([str(p)]) == 0
+
+
+def test_multichip_drill_parity_false_fails():
+    _, regressions, _ = cbr.check_multichip_drill(
+        _drill_doc(model_parity=False))
+    assert any("model_parity=false" in r for r in regressions)
+
+
+def test_multichip_drill_schema_refusals(tmp_path):
+    schema, _, _ = cbr.check_multichip_drill(_drill_doc(version=2))
+    assert any("version" in s for s in schema)
+    schema, _, _ = cbr.check_multichip_drill(
+        _drill_doc(world_sizes={"train": 1, "resume": 1}))
+    assert any("SHRINKING" in s for s in schema)
+    d = _drill_doc()
+    del d["model_parity"]
+    schema, _, _ = cbr.check_multichip_drill(d)
+    assert any("model_parity" in s for s in schema)
+    d = _drill_doc(per_host_ingest_rows=[1024])
+    schema, _, _ = cbr.check_multichip_drill(d)
+    assert any("per_host_ingest_rows" in s for s in schema)
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(_drill_doc(version=2)))
+    assert cbr.main([str(p)]) == 2
+
+
+def test_multichip_drill_survivor_and_rows_gates():
+    d = _drill_doc()
+    d["kill"]["survivor_named_ranks"] = []
+    _, regressions, _ = cbr.check_multichip_drill(d)
+    assert any("named" in r for r in regressions)
+    # a survivor that HUNG (killed by the launcher timeout, -9) or
+    # crashed (1) is a no-hang regression, not a pass
+    for bad in (-9, 1):
+        d = _drill_doc()
+        d["kill"]["survivor_exit_code"] = bad
+        _, regressions, _ = cbr.check_multichip_drill(d)
+        assert any("EXIT_PEER_LOST" in r for r in regressions), bad
+    _, regressions, _ = cbr.check_multichip_drill(
+        _drill_doc(per_host_ingest_rows=[2048, 0]))
+    assert any("without its data shard" in r for r in regressions)
+    _, regressions, _ = cbr.check_multichip_drill(
+        _drill_doc(per_host_ingest_rows=[512, 512]))
+    assert any("dropped" in r for r in regressions)
+
+
+def test_multichip_r06_artifact_passes_gate():
+    """The committed MULTICHIP_r06 artifact (the real drill run) must
+    stay green through its own gate."""
+    path = os.path.join(REPO, "MULTICHIP_r06.json")
+    assert cbr.main([path]) == 0
+    doc = json.loads(open(path).read())
+    assert doc["model_parity"] is True
+    assert doc["world_sizes"] == {"train": 2, "resume": 1}
+
+
 # -- end-to-end (slow): a real quick bench through the gate ------------------
 
 @pytest.mark.slow

@@ -199,3 +199,129 @@ def _trivial():
     m = BinMapper()
     m.find_bin(np.zeros(0), 10, 63, 1, 0)
     return m
+
+
+# ---------------------------------------------------------------------------
+# _rank_rows / _slice_metadata boundary cases
+# ---------------------------------------------------------------------------
+
+def test_rank_rows_uneven_world_partitions_exactly():
+    """n % world != 0: both assignment modes tile [0, n) with no
+    overlap, no loss, and the documented per-rank counts."""
+    from lightgbm_tpu.io.distributed import _rank_rows
+    for n, world in ((2001, 4), (7, 3), (5, 8), (1024, 7)):
+        for mode in ("round_robin", "contiguous"):
+            parts = [_rank_rows(n, r, world, None, mode)
+                     for r in range(world)]
+            allr = np.concatenate(parts)
+            assert len(allr) == n, (n, world, mode)
+            np.testing.assert_array_equal(np.sort(allr), np.arange(n))
+            if mode == "contiguous":
+                # order-preserving blocks: concatenation IS the
+                # original order (the elastic path's parity invariant)
+                np.testing.assert_array_equal(allr, np.arange(n))
+                sizes = [len(p) for p in parts]
+                b = -(-n // world)
+                assert all(s <= b for s in sizes)
+            else:
+                assert [len(p) for p in parts] == [
+                    len(range(r, n, world)) for r in range(world)]
+
+
+def test_rank_rows_world_larger_than_data():
+    """More ranks than rows: trailing ranks legitimately hold zero
+    rows — never a crash, never a duplicated row."""
+    from lightgbm_tpu.io.distributed import _rank_rows
+    for mode in ("round_robin", "contiguous"):
+        parts = [_rank_rows(3, r, 5, None, mode) for r in range(5)]
+        assert sum(len(p) for p in parts) == 3
+        assert any(len(p) == 0 for p in parts)
+
+
+def test_rank_rows_queries_never_split_across_ranks():
+    """Query boundaries: whole queries ride one rank in BOTH modes,
+    including queries that would straddle a naive row boundary (the
+    7-row query sits exactly across n/2)."""
+    from lightgbm_tpu.io.distributed import _rank_rows, _slice_metadata
+    from lightgbm_tpu.io.dataset import Metadata
+
+    sizes = [3, 5, 7, 2, 4, 6, 1, 8]          # 36 rows, uneven
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = int(qb[-1])
+    y = np.arange(n, dtype=np.float32)
+    for mode in ("round_robin", "contiguous"):
+        world = 3
+        seen = []
+        for r in range(world):
+            sel = _rank_rows(n, r, world, qb, mode)
+            seen.append(sel)
+            # every selected row's query is FULLY selected
+            for q in range(len(sizes)):
+                q_rows = set(range(qb[q], qb[q + 1]))
+                inter = q_rows & set(sel.tolist())
+                assert inter in (set(), q_rows), (mode, r, q)
+            # metadata slices agree with the row assignment
+            meta = Metadata(label=y, group=np.asarray(sizes))
+            ml = _slice_metadata(meta, sel, n, r, world, mode)
+            np.testing.assert_array_equal(ml.label, y[sel])
+            assert int(ml.query_boundaries[-1]) == len(sel)
+        allr = np.sort(np.concatenate(seen))
+        np.testing.assert_array_equal(allr, np.arange(n))
+
+
+def test_slice_metadata_multiclass_init_score_uneven():
+    """init_score is the flattened [K*N] layout: per-class slicing
+    must survive an uneven world split."""
+    from lightgbm_tpu.io.distributed import _rank_rows, _slice_metadata
+    from lightgbm_tpu.io.dataset import Metadata
+
+    n, k, world = 10, 3, 4
+    isc = np.arange(k * n, dtype=np.float64)
+    meta = Metadata(label=np.arange(n, dtype=np.float32),
+                    init_score=isc)
+    for mode in ("round_robin", "contiguous"):
+        for r in range(world):
+            sel = _rank_rows(n, r, world, None, mode)
+            ml = _slice_metadata(meta, sel, n, r, world, mode)
+            want = isc.reshape(k, n)[:, sel].reshape(-1)
+            np.testing.assert_array_equal(np.asarray(ml.init_score),
+                                          want)
+
+
+def test_single_rank_world_degenerates_to_serial_bit_identically():
+    """world=1 must be EXACTLY the serial path: same rows, same
+    mappers, same bins — resuming a 1-host cluster cannot differ from
+    never having been distributed."""
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.io.distributed import DistributedLoader
+
+    X, y = make_binary(n=777, f=6, seed=21)
+    cfg = _make_cfg()
+    ds = DistributedLoader(cfg, world=1, rank=0).load_rank_matrix(
+        X, Metadata(label=y))
+    ref = TpuDataset(_make_cfg()).construct_from_matrix(
+        X, Metadata(label=y))
+    assert ds.num_data == ref.num_data == 777
+    assert _infos(ds) == _infos(ref)
+    np.testing.assert_array_equal(ds.host_bins(), ref.host_bins())
+    np.testing.assert_array_equal(ds.metadata.label, ref.metadata.label)
+
+
+def test_contiguous_mode_rank_matrix_blocks():
+    """contiguous=True hands each rank an order-preserving block and
+    the usual agreed mappers."""
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.io.distributed import DistributedLoader
+
+    X, y = make_binary(n=1001, f=5, seed=23)
+    cfg = _make_cfg()
+    world = 3
+    dss = [DistributedLoader(cfg, world=world, rank=r).load_rank_matrix(
+        X, Metadata(label=y), contiguous=True) for r in range(world)]
+    assert [d.num_data for d in dss] == [334, 334, 333]
+    ref = _infos(dss[0])
+    for d in dss[1:]:
+        assert _infos(d) == ref
+    np.testing.assert_array_equal(
+        np.concatenate([d.metadata.label for d in dss]),
+        y.astype(np.float32))

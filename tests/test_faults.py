@@ -236,6 +236,108 @@ def test_checkpoint_volatile_knobs_do_not_change_fingerprint():
     c = Config().set(dict(PARAMS, learning_rate=0.31))
     assert ckpt.config_fingerprint(a) == ckpt.config_fingerprint(b)
     assert ckpt.config_fingerprint(a) != ckpt.config_fingerprint(c)
+    # cluster topology is volatile BY DESIGN: elastic resume means a
+    # 2-process checkpoint restores under 1 process (different rank /
+    # coordinator / world) without a fingerprint refusal
+    d = Config().set(dict(PARAMS, tpu_num_machines=2,
+                          tpu_machine_rank=1,
+                          tpu_coordinator="host:123",
+                          tpu_collective_timeout_s=7.5))
+    assert ckpt.config_fingerprint(a) == ckpt.config_fingerprint(d)
+
+
+def test_checkpoint_world_mismatch_named_in_refusal(tmp_path):
+    """Resuming a sharded checkpoint under a mismatched world size
+    over DIFFERENT data gets a dedicated one-line error naming both
+    world sizes and pointing at the elastic re-shard path's
+    requirement (same data) — not the generic shape message."""
+    g = build_booster(PARAMS)
+    for _ in range(4):
+        g.train_one_iter()
+    ckpt.save_checkpoint(g, str(tmp_path))
+    bundle_path = ckpt.list_checkpoints(str(tmp_path))[0][1]
+    bundle = json.loads(open(bundle_path).read())
+    assert bundle["world"]["processes"] == 1          # written 1-proc
+    # doctor the bundle into "written by a 2-process run over other
+    # data": different world, different row count, wider score buffer
+    bundle["world"].update(processes=2, devices=2, n_real=640,
+                           n_score=768)
+    open(bundle_path, "w").write(json.dumps(bundle))
+    with np.load(ckpt.scores_path(bundle_path)) as z:
+        k = z["scores"].shape[0]
+    with open(ckpt.scores_path(bundle_path), "wb") as fh:
+        np.savez_compressed(fh, scores=np.zeros((k, 768), np.float32))
+    # drop the mapper record: this refusal matrix entry targets the
+    # WORLD mismatch, not the (also different) binning
+    bundle.pop("mappers")
+    open(bundle_path, "w").write(json.dumps(bundle))
+    fresh = build_booster(PARAMS)
+    with pytest.raises(ValueError, match=r"2-process run.*1 process"):
+        ckpt.restore(fresh, ckpt.resolve_resume(str(tmp_path)))
+
+
+def test_checkpoint_elastic_reshard_same_data(tmp_path):
+    """A world-size change over the SAME data re-shards instead of
+    refusing: real rows carry verbatim into this run's (different-
+    width) score buffer; the pad region keeps fresh-init values."""
+    g = build_booster(PARAMS)
+    for _ in range(4):
+        g.train_one_iter()
+    ckpt.save_checkpoint(g, str(tmp_path))
+    bundle_path = ckpt.list_checkpoints(str(tmp_path))[0][1]
+    bundle = json.loads(open(bundle_path).read())
+    n_real = bundle["world"]["n_real"]
+    with np.load(ckpt.scores_path(bundle_path)) as z:
+        saved = z["scores"]
+    # pretend a 2-process run wrote it at a different aligned width;
+    # the pad carries garbage the re-shard must ignore
+    wider = np.pad(saved, ((0, 0), (0, 64)), constant_values=7.0)
+    bundle["world"].update(processes=2, devices=2,
+                           n_score=wider.shape[1])
+    open(bundle_path, "w").write(json.dumps(bundle))
+    with open(ckpt.scores_path(bundle_path), "wb") as fh:
+        np.savez_compressed(fh, scores=wider)
+    fresh = build_booster(PARAMS)
+    it = ckpt.restore(fresh, ckpt.resolve_resume(str(tmp_path)))
+    assert it == 4
+    got = np.asarray(fresh.train_scores())
+    np.testing.assert_array_equal(got, saved[:, :n_real])
+    # and the resumed booster keeps training
+    fresh.train_one_iter()
+
+
+def test_checkpoint_mapper_mismatch_refused(tmp_path):
+    """A dataset binned differently from the checkpointed run is
+    refused by fingerprint — restored thresholds would silently
+    shift — and mappers_from_bundle reconstructs the original binning
+    so an elastic resume can inject it."""
+    g = build_booster(PARAMS)
+    for _ in range(3):
+        g.train_one_iter()
+    ckpt.save_checkpoint(g, str(tmp_path))
+    bundle = ckpt.resolve_resume(str(tmp_path))
+
+    # same config, different data -> different mappers
+    cfg = Config().set(dict(PARAMS))
+    X2, y2 = make_binary(seed=99)
+    ds2 = TpuDataset(cfg).construct_from_matrix(X2, Metadata(label=y2))
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds2.metadata, ds2.num_data)
+    other = GBDT()
+    other.init(cfg, ds2, obj, [])
+    with pytest.raises(ValueError, match="different bin mappers"):
+        ckpt.restore(other, bundle)
+
+    # the bundle's mappers reconstruct the ORIGINAL binning exactly
+    full = ckpt.mappers_from_bundle(bundle)
+    assert len(full) == g.train_data.num_total_features
+    ds3 = TpuDataset(cfg).construct_from_matrix(
+        *(lambda X, y: (X, Metadata(label=y)))(*make_binary()),
+        mappers=full)
+    assert [m.feature_info() for m in ds3.mappers] == \
+        [m.feature_info() for m in g.train_data.mappers]
+    assert ckpt.mapper_fingerprint(ds3.mappers) == \
+        bundle["mappers"]["hash"]
 
 
 # ---------------------------------------------------------------------------

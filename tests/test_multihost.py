@@ -1,0 +1,316 @@
+"""Real multi-process training + elastic resume (pytest -m multihost).
+
+The multichip suite proves the sharded learners on a VIRTUAL mesh;
+this suite proves the runtime that makes the same programs span real
+OS processes (lightgbm_tpu/parallel/cluster.py + elastic.py):
+
+- unit layer: rank-naming error mapping, the DeadlineGuard stall
+  watchdog, world-invariant shard geometry (the property that makes
+  elastic resume shape-preserving), host-block tiling, and the
+  multihost ingest's bit-parity with the single-process sharded path;
+- process layer: a 2-process ``jax.distributed`` smoke over localhost
+  (both ranks must finish and agree on the trained model hash), and
+  the no-hang drill — SIGKILL one rank mid-collective, the survivor
+  must exit with a rank-naming error within the configured deadline;
+- the full elastic drill (slow): train on 2 processes, kill one,
+  resume the survivor on a 1-process mesh from the latest checkpoint,
+  final model bit-identical to the uninterrupted run
+  (parallel/elastic.py run_drill — the MULTICHIP_r06 artifact).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from conftest import TEST_PARAMS
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel import cluster, elastic
+
+pytestmark = pytest.mark.multihost
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _make_cfg(**kw):
+    full = dict(TEST_PARAMS)
+    full.update({"objective": "binary"})
+    full.update(kw)
+    return Config().set(full)
+
+
+# ---------------------------------------------------------------------------
+# cluster units (in-process)
+# ---------------------------------------------------------------------------
+
+def test_explain_names_ranks_from_task_strings():
+    e = RuntimeError(
+        "DEADLINE_EXCEEDED: Barrier timed out. Id: x::0.\n"
+        "The first task at the barrier: "
+        "/job:jax_worker/replica:0/task:0. Some timed out task names:\n"
+        "/job:jax_worker/replica:0/task:2\n")
+    err = cluster.explain_collective_error(e, what="barrier 'sync'")
+    assert isinstance(err, cluster.PeerLostError)
+    assert err.ranks == [2]
+    assert "rank 2" in str(err)
+    assert "checkpoint" in str(err)          # actionable next step
+    # one line: the promise is a rank-naming ERROR, not a traceback
+    assert "\n" not in str(err).strip()
+
+
+def test_explain_classifies_gloo_reset_without_task_names():
+    err = cluster.explain_collective_error(RuntimeError(
+        "FAILED_PRECONDITION: Buffer Definition Event: Gloo "
+        "all-reduce failed: Read error [127.0.0.1]:30356: "
+        "Connection reset by peer"), what="training")
+    assert isinstance(err, cluster.PeerLostError)
+    assert "resume" in str(err)
+
+
+def test_explain_leaves_genuine_bugs_alone():
+    assert cluster.explain_collective_error(
+        ValueError("shapes (3,) and (4,) not aligned")) is None
+    assert cluster.explain_collective_error(
+        KeyError("feature_fraction")) is None
+
+
+def test_deadline_guard_fires_names_rank_and_respects_progress():
+    fired = []
+    with cluster.DeadlineGuard(deadline=0.5, what="unit collective",
+                               on_stall=fired.append,
+                               probe=lambda: [1],
+                               poll_s=0.05) as g:
+        cluster.tick("iteration 3")
+        time.sleep(1.1)
+    assert g.fired
+    err = fired[0]
+    assert isinstance(err, cluster.PeerLostError)
+    assert err.ranks == [1]
+    assert "rank 1" in str(err) and "iteration 3" in str(err)
+    assert "unit collective" in str(err)
+
+    # a live tick stream keeps the guard quiet
+    with cluster.DeadlineGuard(deadline=0.5, on_stall=fired.append,
+                               probe=lambda: [0], poll_s=0.05) as g2:
+        for _ in range(14):
+            cluster.tick("hot loop")
+            time.sleep(0.05)
+    assert not g2.fired
+
+    # coordinator-gone probe (None): suspect is rank 0
+    dead = []
+    with cluster.DeadlineGuard(deadline=0.3, on_stall=dead.append,
+                               probe=lambda: None, poll_s=0.05):
+        cluster.tick("x")
+        time.sleep(0.8)
+    assert dead and dead[0].ranks == [0]
+    assert "coordinator" in str(dead[0])
+
+    # all peers ALIVE (probe returns []): a slow step must NOT read
+    # as a cluster death — the guard warns and keeps waiting
+    alive = []
+    with cluster.DeadlineGuard(deadline=0.2, on_stall=alive.append,
+                               probe=lambda: [], poll_s=0.05) as g3:
+        cluster.tick("slow compile")
+        time.sleep(0.7)
+    assert not g3.fired and alive == []
+
+
+def test_barrier_is_noop_single_process():
+    cluster.barrier("unit-barrier", timeout_s=0.05)   # must not block
+
+
+def test_shard_geometry_world_invariance_and_rebucket():
+    """At pow2-friendly shapes, bucket_rows over shard_align_unit
+    yields the SAME score width for every world size — a world change
+    is then purely a re-sharding, and resume is verbatim. At shapes
+    where the alignment units do NOT divide the bucket the widths
+    differ — exactly the case checkpoint restore's elastic re-shard
+    path (utils/checkpoint.py) exists for."""
+    from lightgbm_tpu.ops import step_cache as sc
+    for n in (2048, 4096, 1 << 20, 11_010_048):
+        widths = {sc.bucket_rows(n, sc.shard_align_unit(n, D, 16384),
+                                 policy=-1)
+                  for D in (1, 2, 4, 8)}
+        assert len(widths) == 1, (n, widths)
+    # a width-changing transition (the re-shard case): TPU-serial
+    # chunk alignment vs a 2-chip mesh at an awkward n
+    n = 100_000
+    w1 = sc.bucket_rows(n, sc.shard_align_unit(n, 1, 16384), policy=-1)
+    w2 = sc.bucket_rows(n, sc.shard_align_unit(n, 2, 16384), policy=-1)
+    assert w1 != w2
+    assert min(w1, w2) >= n      # both still cover every real row
+
+
+def test_host_row_block_tiles_the_matrix():
+    from lightgbm_tpu.io.ingest import host_row_block, shard_width
+    from lightgbm_tpu.parallel.learners import make_mesh
+    mesh = make_mesh(8)
+    n = 1000
+    lo, hi, S = host_row_block(n, mesh)
+    # single process: this host owns every block
+    assert (lo, hi) == (0, n)
+    assert S == shard_width(n, 8, 0)
+    assert 8 * S >= n
+
+
+def test_bin_matrix_multihost_matches_sharded_single_process():
+    """The multihost assembly maps the SAME device->row-block layout
+    as bin_matrix_sharded — on one process the two must be bit-equal,
+    which is what makes a W-process mesh reproduce the virtual mesh's
+    (proven) layout."""
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.io.ingest import DeviceBinner
+    from lightgbm_tpu.parallel.learners import make_mesh
+
+    r = np.random.default_rng(5)
+    X = r.normal(size=(1024, 6))
+    X[::17, 2] = np.nan
+    cfg = _make_cfg(tpu_ingest=1)
+    ds = TpuDataset(cfg).construct_from_matrix(
+        X, Metadata(label=(X[:, 0] > 0).astype(np.float32)))
+    binner = DeviceBinner(ds.mappers, ds.used_feature_map, cfg,
+                          X.dtype)
+    mesh = make_mesh(8)
+    a = binner.bin_matrix_sharded(X, mesh)
+    b = binner.bin_matrix_multihost(X, mesh, X.shape[0], 0)
+    assert a.shape == b.shape
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # a rank whose rows do not cover its devices' blocks is refused
+    # with an actionable error, never mis-assembled
+    with pytest.raises(ValueError, match="host_row_block"):
+        binner.bin_matrix_multihost(X[:100], mesh, X.shape[0], 0)
+
+
+def test_construct_multihost_single_process_matches_reference():
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.io.distributed import DistributedLoader
+    from lightgbm_tpu.parallel.learners import make_mesh
+
+    r = np.random.default_rng(9)
+    X = r.normal(size=(600, 5))
+    y = (X[:, 0] > 0).astype(np.float32)
+    cfg = _make_cfg(tpu_ingest=1, tree_learner="data")
+    mesh = make_mesh(8)
+    ds = DistributedLoader(cfg).construct_multihost(
+        X, Metadata(label=y), n_global=600, row_start=0, mesh=mesh)
+    ref = TpuDataset(_make_cfg()).construct_from_matrix(
+        X, Metadata(label=y))
+    assert [m.feature_info() for m in ds.mappers] == \
+        [m.feature_info() for m in ref.mappers]
+    assert ds.num_data == 600
+    got = np.asarray(ds.bins_t_dev)[:, :600].T
+    np.testing.assert_array_equal(got, ref.host_bins().astype(got.dtype))
+
+
+def test_strip_volatile_model_text():
+    a = ("tree\nTree=0\nstuff\n\nparameters:\n"
+         "[tpu_checkpoint_dir: /a/ckpt]\nend of parameters\ntail\n")
+    b = a.replace("/a/ckpt", "/c/ckpt")
+    assert a != b
+    assert elastic._strip_volatile(a) == elastic._strip_volatile(b)
+    # tree bytes still covered
+    c = a.replace("Tree=0", "Tree=1")
+    assert elastic._strip_volatile(a) != elastic._strip_volatile(c)
+
+
+def test_retry_classifier_knows_dcn_strings():
+    from lightgbm_tpu.utils import retry
+
+    class E(Exception):
+        pass
+
+    for msg in (
+            "failed to connect to all addresses; last error: "
+            "UNKNOWN: Connection refused",
+            "DEADLINE_EXCEEDED: Barrier timed out. Id: init::0",
+            "UNAVAILABLE: Task /job:jax_worker/replica:0/task:1 "
+            "heartbeat timeout",
+            "INTERNAL: Coordination service has been shut down"):
+        assert retry.is_transient(E(msg)), msg
+    assert not retry.is_transient(E("Unknown parameter: learning_rat"))
+
+
+# ---------------------------------------------------------------------------
+# real processes over localhost
+# ---------------------------------------------------------------------------
+
+_SKIP_SPAWN = bool(os.environ.get("LGBM_TPU_SKIP_MULTIHOST"))
+
+
+@pytest.mark.skipif(_SKIP_SPAWN, reason="LGBM_TPU_SKIP_MULTIHOST set")
+def test_two_process_smoke(tmp_path):
+    """2 REAL jax.distributed processes train one sharded model: both
+    ranks finish, agree on the model hash bit-for-bit, and each
+    ingested exactly its own contiguous host block."""
+    out = elastic.run_two_process(str(tmp_path), n=768, iterations=3)
+    r0, r1 = out["rank_results"]
+    assert r0["model_sha"] == r1["model_sha"]
+    assert [r0["host_row_block"], r1["host_row_block"]] == \
+        [[0, 384], [384, 768]]
+    assert r0["ingest_rows_local"] == r1["ingest_rows_local"] == 384
+    assert r0["iterations"] == 3
+    assert out["result"]["train_auc"] > 0.9
+
+
+@pytest.mark.skipif(_SKIP_SPAWN, reason="LGBM_TPU_SKIP_MULTIHOST set")
+def test_peer_kill_names_rank_and_never_hangs(tmp_path):
+    """SIGKILL rank 1 mid-training: rank 0 must exit EXIT_PEER_LOST
+    within the collective deadline, with ONE line naming rank 1 — the
+    no-hang guarantee, measured on real processes."""
+    deadline_s = 15.0
+    spec = {
+        "seed": 0, "n": 512, "f": 6,
+        "params": {"num_iterations": 6,
+                   "tpu_collective_timeout_s": deadline_s},
+        "out": str(tmp_path / "result.json"),
+        "checkpoint_dir": str(tmp_path / "ckpt"),
+    }
+    spec_path = str(tmp_path / "spec.json")
+    with open(spec_path, "w") as fh:
+        json.dump(spec, fh)
+    procs = elastic.launch_workers(
+        spec_path, 2, log_dir=str(tmp_path), fault_rank=1,
+        faults="train.iter@3:kill")
+    # the victim dies by SIGKILL
+    rc1 = procs[1].wait(timeout=240)
+    assert rc1 == -9, rc1
+    t0 = time.monotonic()
+    # the survivor must exit WITHIN the deadline (+ probe/IO slack) —
+    # a hang here is exactly the failure mode this layer removes
+    rc0 = procs[0].wait(timeout=deadline_s + 30)
+    waited = time.monotonic() - t0
+    assert rc0 == cluster.EXIT_PEER_LOST, rc0
+    assert waited < deadline_s + 15, waited
+    surv = json.loads((tmp_path / "result.json.rank0").read_text())
+    assert surv["peer_lost"] is True
+    assert surv["dead_ranks"] == [1]
+    assert "rank 1" in surv["error"]
+    assert "checkpoint" in surv["error"]
+    # checkpoints survived for the resume that would follow
+    from lightgbm_tpu.utils import checkpoint as ckpt
+    assert ckpt.list_checkpoints(str(tmp_path / "ckpt"))
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(_SKIP_SPAWN, reason="LGBM_TPU_SKIP_MULTIHOST set")
+def test_elastic_drill_end_to_end(tmp_path):
+    """The full preemption drill: uninterrupted 2-process run, killed
+    2-process run, 1-process resume — final model bit-identical; the
+    artifact passes the regression gate."""
+    out = elastic.run_drill(str(tmp_path), n=2048, iterations=8,
+                            kill_at=5, collective_timeout_s=20)
+    assert out["model_parity"] is True
+    assert out["kill"]["survivor_named_ranks"] == [1]
+    assert out["kill"]["survivor_exit_code"] == cluster.EXIT_PEER_LOST
+    assert out["resume"]["from_iteration"] == 4
+    assert out["per_host_ingest_rows"] == [1024, 1024]
+
+    import check_bench_regression as cbr
+    schema, regressions, _ = cbr.check_multichip_drill(out)
+    assert schema == [] and regressions == []

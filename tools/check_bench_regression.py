@@ -532,6 +532,99 @@ def _compare_latency(fresh: dict, baseline: dict,
     return problems
 
 
+MULTICHIP_DRILL_SCHEMA = "lightgbm-tpu/multichip-drill"
+
+
+def check_multichip_drill(doc: dict) -> tuple:
+    """(schema_problems, regressions, notes) for an elastic-drill
+    artifact (parallel/elastic.py run_drill -> MULTICHIP_r06+). The
+    shape carries the drill's whole verdict, so the gate is absolute —
+    no trajectory walk-back: ``model_parity=false`` (the resumed model
+    diverged from the uninterrupted run) fails the artifact, as does a
+    survivor that never named the dead rank or hung past its exit."""
+    schema: List[str] = []
+    regressions: List[str] = []
+    notes: List[str] = []
+    if doc.get("version") != 1:
+        return ([f"multichip-drill version {doc.get('version')!r}, "
+                 f"this checker wants 1"], [], [])
+    ws = doc.get("world_sizes")
+    if not (isinstance(ws, dict)
+            and isinstance(ws.get("train"), int)
+            and isinstance(ws.get("resume"), int)):
+        schema.append("world_sizes must carry int train/resume")
+        ws = {}
+    elif not (ws["train"] > ws["resume"] >= 1):
+        schema.append(f"world_sizes train={ws['train']} must exceed "
+                      f"resume={ws['resume']} >= 1 (the drill proves a "
+                      f"SHRINKING mesh)")
+    parity = doc.get("model_parity")
+    if not isinstance(parity, bool):
+        schema.append("model_parity flag missing or non-boolean — the "
+                      "drill's verdict must be recorded")
+    elif not parity:
+        regressions.append(
+            "model_parity=false: the resumed model diverged from the "
+            "uninterrupted run — elastic resume is broken")
+    kill = doc.get("kill")
+    if not isinstance(kill, dict):
+        schema.append("kill section missing")
+    else:
+        named = kill.get("survivor_named_ranks")
+        if not (isinstance(named, list) and named
+                and all(isinstance(r, int) for r in named)):
+            regressions.append(
+                "kill.survivor_named_ranks empty: the survivor never "
+                "named the dead rank (the no-hang guarantee demands "
+                "one actionable line)")
+        code = kill.get("survivor_exit_code")
+        if not isinstance(code, int):
+            schema.append("kill.survivor_exit_code missing")
+        elif code != 17:    # cluster.EXIT_PEER_LOST
+            regressions.append(
+                f"kill.survivor_exit_code={code}: expected "
+                f"EXIT_PEER_LOST (17) — a -9 means the survivor HUNG "
+                f"and was killed at the launcher timeout; any other "
+                f"code means it crashed instead of exiting cleanly")
+    res = doc.get("resume")
+    if not isinstance(res, dict) \
+            or not isinstance(res.get("from_iteration"), int):
+        schema.append("resume.from_iteration missing — the artifact "
+                      "must record which checkpoint carried the run")
+    rows = doc.get("per_host_ingest_rows")
+    train_w = ws.get("train") if isinstance(ws, dict) else None
+    if not isinstance(rows, list) or (
+            isinstance(train_w, int) and len(rows) != train_w):
+        schema.append(f"per_host_ingest_rows must list one entry per "
+                      f"training host (got {rows!r} for "
+                      f"{train_w} hosts)")
+    else:
+        if any(not isinstance(r, (int, float)) or r <= 0
+               for r in rows):
+            regressions.append(
+                f"per_host_ingest_rows {rows}: every host must have "
+                f"ingested rows — a zero means a rank trained without "
+                f"its data shard")
+        else:
+            n = (doc.get("workload") or {}).get("n")
+            if isinstance(n, int) and sum(rows) < n:
+                regressions.append(
+                    f"per_host_ingest_rows sum {sum(rows)} < workload "
+                    f"n {n}: rows were dropped on the way in")
+            notes.append(f"per-host ingest rows: {rows}")
+    for k in ("train_auc", "resumed_auc"):
+        v = doc.get(k)
+        if v is not None and not isinstance(v, (int, float)):
+            schema.append(f"{k} must be numeric or null")
+        elif v is not None:
+            notes.append(f"{k}={v:.4f}")
+    walls = doc.get("wall_s")
+    if isinstance(walls, dict):
+        notes.append("walls: " + ", ".join(
+            f"{k}={v}s" for k, v in walls.items()))
+    return schema, regressions, notes
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Gate a fresh bench JSON against the BENCH_r0x "
@@ -572,6 +665,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"cannot read {args.fresh}: {e}", file=sys.stderr)
         return 2
+    if fresh.get("schema") == MULTICHIP_DRILL_SCHEMA:
+        # elastic-drill artifact (MULTICHIP_r06+): self-contained
+        # verdict, no trajectory comparison
+        schema, regressions, notes = check_multichip_drill(fresh)
+        for p in schema:
+            print(f"SCHEMA: {p}", file=sys.stderr)
+        if schema:
+            return 2
+        for note in notes:
+            print(f"NOTE: {note}")
+        for p in regressions:
+            print(f"REGRESSION (drill): {p}", file=sys.stderr)
+        if regressions:
+            return 1
+        ws = fresh["world_sizes"]
+        print(f"pass: elastic drill {ws['train']}->{ws['resume']} "
+              f"processes, resume from iteration "
+              f"{fresh['resume']['from_iteration']}, model parity "
+              f"bit-identical")
+        return 0
     problems = check_schema(fresh)
     if problems:
         for p in problems:
