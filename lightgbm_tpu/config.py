@@ -300,6 +300,40 @@ class Config:
     # int32 sum bound; the int-vs-f32 wire choice is autotuned on real
     # meshes, ops/autotune.py); 0 = off (f32 psum); 1 = force.
     tpu_quantized_psum: int = -1
+    # packed psum wire width (parallel/learners.py): with the
+    # quantized psum active the collective payload is integer-valued,
+    # so it can cross the DCN as int16 (or int8) whenever the
+    # 127 * num_rows_global wrap bound proves the narrow sum cannot
+    # overflow — the narrowing cast, integer psum and widening cast
+    # are all exact, so the result is BIT-identical to the int32 wire.
+    # The same knob gates the delta-encoded (code, feat, row)
+    # coordinate transport of the sparse tier (io/sparse.py). -1 =
+    # auto (narrowest provably-safe width); 0 = legacy int32/f32 wire;
+    # 1 = force-narrow where safe (falls back with a warning where the
+    # wrap bound refuses).
+    tpu_psum_wire: int = -1
+    # overlap-structured histogram collective (parallel/learners.py):
+    # split the [wave, feature, bin, channel] histogram psum into
+    # independent double-buffered slot collectives along the feature
+    # axis so XLA can overlap one slot's DCN reduction with local
+    # compute instead of stalling the step on a single monolithic
+    # psum. psum is elementwise across shards, so the slot split is
+    # BIT-identical to the fused collective (for f32 AND integer
+    # wires). -1 = auto (async slots on data-parallel meshes; the
+    # async-vs-sync arm is autotuned per (mesh, payload) key on real
+    # TPUs, ops/autotune.py tune_hist_psum_async); 0 = sync (one
+    # psum); 1 = force async slots.
+    tpu_async_psum: int = -1
+    # background checkpoint writer (utils/checkpoint.py): the
+    # collective score gather stays on the training path, but rank-0's
+    # bundle serialization + atomic file writes move to a bounded-queue
+    # writer thread, hiding checkpoint I/O behind subsequent
+    # iterations. Commit-point ordering is preserved (scores sidecar
+    # first, bundle second, both atomic_write), checkpoint/
+    # write_failures semantics are unchanged, and the queue is drained
+    # at train end and before any resume read. -1 = auto (on when
+    # checkpointing is active); 0 = synchronous writes; 1 = force.
+    tpu_ckpt_async: int = -1
     # 4-bit packed HBM bins (the reference's Dense4bitsBin as a COMPUTE
     # tier, dense_nbits_bin.hpp): when max_bin <= 16 and either the
     # count-proxy int8 path or the hi/lo exact tier (tpu_use_dp) is
@@ -787,6 +821,18 @@ class Config:
             log.warning("tpu_quantized_psum=%d is not one of -1/0/1; "
                         "using -1 (auto)", self.tpu_quantized_psum)
             self.tpu_quantized_psum = -1
+        if self.tpu_psum_wire not in (-1, 0, 1):
+            log.warning("tpu_psum_wire=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_psum_wire)
+            self.tpu_psum_wire = -1
+        if self.tpu_async_psum not in (-1, 0, 1):
+            log.warning("tpu_async_psum=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_async_psum)
+            self.tpu_async_psum = -1
+        if self.tpu_ckpt_async not in (-1, 0, 1):
+            log.warning("tpu_ckpt_async=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_ckpt_async)
+            self.tpu_ckpt_async = -1
         if self.tpu_ingest not in (-1, 0, 1):
             log.warning("tpu_ingest=%d is not one of -1/0/1; using -1 "
                         "(auto)", self.tpu_ingest)

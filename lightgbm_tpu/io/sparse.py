@@ -264,6 +264,32 @@ def want_coords(config, density: float) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Delta-encoded coordinate transport (config.tpu_psum_wire)
+# ---------------------------------------------------------------------------
+
+def delta_pack_plane(arr) -> Optional[Tuple[int, np.ndarray]]:
+    """Pack an int coordinate plane for the host->device wire as
+    ``(base, int16 deltas)`` — half the transfer bytes of the int32
+    plane. The planes are feature-grouped and row-sorted within each
+    feature (``_entries_by_column``), so adjacent deltas are tiny for
+    the row/feat planes and bin-bounded (|d| <= max_bin) for the code
+    plane; reconstruction is ``base + cumsum(deltas)`` in int32 on
+    device — exact integer arithmetic, so the rebuilt plane is
+    BIT-identical to the direct upload. Returns None (the refusal
+    path) when any adjacent delta falls outside int16 — the caller
+    then uploads the plane directly."""
+    a = np.asarray(arr, np.int64).ravel()
+    if a.size < 2:
+        return None
+    d = np.diff(a)
+    if d.max(initial=0) > 32767 or d.min(initial=0) < -32768:
+        return None
+    out = np.zeros(a.size, np.int16)
+    out[1:] = d.astype(np.int16)
+    return int(a[0]), out
+
+
+# ---------------------------------------------------------------------------
 # Bin-mapper construction from CSR
 # ---------------------------------------------------------------------------
 

@@ -658,6 +658,34 @@ class GBDT:
                         "with tree_learner=data on a multi-device mesh "
                         "and no EFB bundles; using the f32 reduction")
 
+        # packed wire + overlap slots (tpu_psum_wire / tpu_async_psum):
+        # both arms live in the grower config so the step-cache
+        # geometry key separates programs compiled for different
+        # wire/slot choices, and both are bit-identical to the legacy
+        # collective (parallel/learners.py make_hist_reduce)
+        psum_wire = "int32"
+        psum_slots = 1
+        if mode == "data" and mesh is not None:
+            from ..ops.autotune import (tune_hist_psum_async,
+                                        tune_psum_wire)
+            if quant_psum:
+                psum_wire = tune_psum_wire(
+                    n_rows_global=self._n_pad,
+                    requested=cfg.tpu_psum_wire)
+            elif cfg.tpu_psum_wire == 1:
+                log.warning("tpu_psum_wire=1 needs the quantized psum "
+                            "(tpu_quantized_psum) active; the f32 "
+                            "wire cannot be narrowed exactly")
+            psum_slots = tune_hist_psum_async(
+                mesh=mesh, W=W, F=self._f_pad, B=B_hist,
+                channels=2 if proxy else 3,
+                wire=psum_wire if quant_psum else "f32",
+                requested=cfg.tpu_async_psum)
+        elif cfg.tpu_async_psum == 1:
+            log.warning("tpu_async_psum=1 needs tree_learner=data on a "
+                        "multi-device mesh; the serial histogram has "
+                        "no collective to overlap")
+
         gcfg = WaveGrowerConfig(
             num_leaves=max(cfg.num_leaves, 2),
             # >= 2 so the per-feature split scan is never empty (the
@@ -676,6 +704,8 @@ class GBDT:
             count_proxy=proxy,
             packed4=packed4,
             quant_psum=quant_psum,
+            psum_wire=psum_wire,
+            psum_slots=psum_slots,
             sparse_hist=sparse_tier)
         self._grower_cfg = gcfg
         hist_fn = None
@@ -1039,9 +1069,9 @@ class GBDT:
         from ..ops import step_cache
         td = self.train_data
         f = max(td.num_features, 1)
-        codes = jnp.asarray(td.sparse_coords[0]).astype(jnp.int32)
-        feat = jnp.asarray(td.sparse_coords[1]).astype(jnp.int32)
-        rows = jnp.asarray(td.sparse_coords[2]).astype(jnp.int32)
+        codes = self._upload_plane(td.sparse_coords[0])
+        feat = self._upload_plane(td.sparse_coords[1])
+        rows = self._upload_plane(td.sparse_coords[2])
         feat = jnp.where(feat >= f, jnp.int32(self._f_pad), feat)
         E = int(codes.shape[0])
         Ep = (step_cache.bucket_entries(E, self.config.tpu_row_bucket)
@@ -1060,6 +1090,24 @@ class GBDT:
                  "(bucketed to %d) over %d features", E, Ep,
                  self._f_pad)
         return (codes, feat, rows, jnp.asarray(zb))
+
+    def _upload_plane(self, arr) -> jax.Array:
+        """One sparse coordinate plane to device, delta-encoded across
+        the host->device wire where tpu_psum_wire allows and the int16
+        delta bound holds (io/sparse.py delta_pack_plane; 0 = legacy
+        int32 transport). Reconstruction by int32 cumsum is exact, so
+        the device plane is bit-identical either way."""
+        if (self.config.tpu_psum_wire != 0
+                and isinstance(arr, np.ndarray)):
+            from ..io.sparse import delta_pack_plane
+            packed = delta_pack_plane(arr)
+            if packed is not None:
+                base, d16 = packed
+                from ..obs import registry as obs
+                obs.counter("comm/wire_bytes_saved").add(2 * d16.size)
+                return (jnp.int32(base)
+                        + jnp.cumsum(jnp.asarray(d16).astype(jnp.int32)))
+        return jnp.asarray(arr).astype(jnp.int32)
 
     def _step_bins(self):
         """The fused step's bins argument: the dense matrix, paired
@@ -1528,38 +1576,104 @@ class GBDT:
                  for grp in leaves]
         return leaves, waves
 
+    def wire_encoding(self) -> str:
+        """The histogram-collective wire encoding this booster trains
+        with: "" off the data-parallel path (no collective), "f32" for
+        the dequantize-first wire, else the quantized wire's dtype
+        ("int32"/"int16"/"int8", config.tpu_psum_wire). Surfaces as
+        ``meta.wire`` in run reports."""
+        if self._mesh is None or self._learner_mode != "data":
+            return ""
+        gcfg = self._grower_cfg
+        return gcfg.psum_wire if gcfg.quant_psum else "f32"
+
     def record_comm_bytes(self, recorder, waves) -> Optional[list]:
         """Attach per-iteration psum payload bytes (and the cumulative
-        comm counters) to a RunRecorder; returns the byte list, or
-        None off the data-parallel path."""
+        comm counters, including the packed-wire savings and the
+        measured stall-time estimate) to a RunRecorder; returns the
+        byte list, or None off the data-parallel path."""
         comm = self._comm_bytes_per_iteration(waves)
         if comm:
             from ..obs import registry as obs
             for i, cb in enumerate(comm):
                 recorder.set_field(i + 1, "comm_bytes", cb)
             obs.counter("comm/psum_bytes").add(sum(comm))
-            obs.counter("comm/psum_passes").add(
-                sum(waves) + self.num_tree_per_iteration * len(waves))
+            passes = (sum(waves)
+                      + self.num_tree_per_iteration * len(waves))
+            obs.counter("comm/psum_passes").add(passes)
+            saved = self._wire_bytes_saved_per_pass() * passes
+            if saved:
+                obs.counter("comm/wire_bytes_saved").add(saved)
+            stall = self.psum_stall_estimate_s(passes)
+            if stall is not None:
+                obs.counter("comm/psum_stall_s").add(stall)
         return comm
+
+    def psum_stall_estimate_s(self, passes: int) -> Optional[float]:
+        """Seconds the run would stall on the histogram collective:
+        MEASURED per-pass wall of the real psum payload on the real
+        mesh (ops/autotune.py measure_psum_s — outside the compiled
+        step, where in-step timing is impossible) x pass count. None
+        off the data-parallel path."""
+        if self._mesh is None or self._learner_mode != "data" \
+                or passes <= 0:
+            return None
+        gcfg = self._grower_cfg
+        from ..ops.autotune import measure_psum_s
+        from ..parallel.learners import _WIRE_DTYPES
+        C = self._wire_channels()
+        dtype = (_WIRE_DTYPES[gcfg.psum_wire] if gcfg.quant_psum
+                 else jnp.float32)
+        shape = (gcfg.wave_size, self._f_pad, gcfg.num_bins, C)
+        try:
+            per_pass = measure_psum_s(self._mesh, shape, dtype)
+        except Exception as e:        # a measurement must never take
+            log.debug("psum stall measurement failed: %s", e)
+            return None               # accounting (or training) down
+        return float(per_pass) * int(passes)
+
+    def _wire_channels(self) -> int:
+        """Channel count of the histogram-collective payload."""
+        from ..utils.device import on_tpu
+        # the 2-channel proxy wire only exists where the Pallas fused
+        # kernel runs (the XLA oracle keeps 3 exact channels)
+        return 2 if (self._grower_cfg.count_proxy and on_tpu()) else 3
+
+    def _wire_entry_bytes(self) -> int:
+        """Bytes per histogram entry on the wire: 4 for f32/int32, 2
+        for the packed int16 wire, 1 for int8 (tpu_psum_wire)."""
+        gcfg = self._grower_cfg
+        if not gcfg.quant_psum:
+            return 4
+        return {"int8": 1, "int16": 2}.get(gcfg.psum_wire, 4)
+
+    def _wire_bytes_saved_per_pass(self) -> int:
+        """Bytes per collective pass the packed wire keeps off the
+        DCN relative to the 4-byte legacy wire."""
+        width_saved = 4 - self._wire_entry_bytes()
+        if not width_saved:
+            return 0
+        gcfg = self._grower_cfg
+        F_h = max(self.train_data.num_features, 1)
+        return (gcfg.wave_size * F_h * gcfg.num_bins
+                * self._wire_channels() * width_saved)
 
     def _comm_bytes_per_iteration(self, waves) -> Optional[list]:
         """Per-iteration cross-chip psum payload bytes on the
         data-parallel path (None otherwise): each class tree pays one
         root histogram pass plus one per wave step, and each pass
-        reduces a [W, F_hist, B, C] block (4-byte entries on either
-        wire — int32 quantized or f32; the count-proxy tier carries 2
-        channels instead of 3). Scalar reductions (root aggregates,
-        quantization pmax) are a few hundred bytes per tree and are
-        not counted."""
+        reduces a [W, F_hist, B, C] block (entry width set by the
+        wire — 4 bytes f32/int32, 2/1 packed int16/int8; the
+        count-proxy tier carries 2 channels instead of 3). Scalar
+        reductions (root aggregates, quantization pmax) are a few
+        hundred bytes per tree and are not counted."""
         if self._mesh is None or self._learner_mode != "data":
             return None
         gcfg = self._grower_cfg
-        from ..utils.device import on_tpu
-        # the 2-channel proxy wire only exists where the Pallas fused
-        # kernel runs (the XLA oracle keeps 3 exact channels)
-        C = 2 if (gcfg.count_proxy and on_tpu()) else 3
+        C = self._wire_channels()
         F_h = max(self.train_data.num_features, 1)
-        per_pass = gcfg.wave_size * F_h * gcfg.num_bins * C * 4
+        per_pass = (gcfg.wave_size * F_h * gcfg.num_bins * C
+                    * self._wire_entry_bytes())
         K = self.num_tree_per_iteration
         return [(int(w) + K) * per_pass for w in waves]
 
@@ -2334,11 +2448,16 @@ class GBDT:
                 booster_eligible=bool(getattr(self, "_cache_eligible",
                                               False)))
             recorder.meta["predict_cache"] = predict_cache.stats()
+            recorder.meta["wire"] = self.wire_encoding()
             recorder.finish(
                 leaves_per_iteration=leaves, waves_per_iteration=waves,
                 extra={"trained_iterations": self.iter_,
                        "stopped_early": bool(self._stopped)})
         finally:
+            # background checkpoint writes drain before train()
+            # returns — callers may read the directory (or kill the
+            # process) the moment control comes back
+            self._drain_checkpoints()
             # exception path: close an open trace, write the partial
             # report, clear the log prefix (finish() is idempotent —
             # the normal path above already finished with leaf counts)
@@ -2348,6 +2467,7 @@ class GBDT:
             recorder.meta.setdefault("step_cache", step_cache.stats())
             recorder.meta.setdefault("predict_cache",
                                      predict_cache.stats())
+            recorder.meta.setdefault("wire", self.wire_encoding())
             recorder.finish(extra={"aborted": True})
         timing.log_report("training phase timings "
                           "(serial_tree_learner.cpp:14-41 analog)")
@@ -2377,12 +2497,25 @@ class GBDT:
         an injected ``checkpoint.write`` fault — warn and NEVER stop
         or corrupt training: the atomic write leaves the previous
         complete bundle intact. Public: engine.train's periodic
-        checkpoint wiring calls this too."""
+        checkpoint wiring calls this too.
+
+        With tpu_ckpt_async (-1 auto = on) the file writes ride a
+        background writer thread (utils/checkpoint.py
+        AsyncCheckpointWriter): the collective score gather and the
+        bundle construction still happen here, on-path; only the
+        serialization + atomic writes are hidden behind subsequent
+        iterations. The queue drains at train end and before any
+        resume read."""
         from ..utils import checkpoint as ckpt
+        writer = None
+        if self.config.tpu_ckpt_async != 0:
+            writer = getattr(self, "_ckpt_writer", None)
+            if writer is None:
+                writer = self._ckpt_writer = ckpt.new_writer()
         try:
             return ckpt.save_checkpoint(
                 self, directory, keep=max(self.config.tpu_snapshot_keep,
-                                          1))
+                                          1), writer=writer)
         except Exception as e:      # noqa: BLE001 — durability aid:
             # a checkpoint is insurance, never the failure itself
             from ..obs import registry as obs
@@ -2392,6 +2525,15 @@ class GBDT:
                         "checkpoint is intact", directory,
                         self.current_iteration, type(e).__name__, e)
             return None
+
+    def _drain_checkpoints(self) -> None:
+        """Block until this booster's background checkpoint writer has
+        committed every queued bundle (no-op when sync or none were
+        written). Called at train end; resolve_resume drains all
+        writers itself before any read."""
+        writer = getattr(self, "_ckpt_writer", None)
+        if writer is not None:
+            writer.drain()
 
     def _eval_and_check_early_stopping(self, it: int, values=None,
                                        extra_drop: int = 0) -> bool:

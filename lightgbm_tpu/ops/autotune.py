@@ -731,6 +731,126 @@ def _psum_measure_fn(mesh, shape):
     return lambda cand: timing.measure(fns[cand["wire"]])
 
 
+# packed-wire wrap bounds: the quantized per-shard histogram entry is a
+# sum of int8 values in [-127, 127], so |entry| <= 127 * n_rows_global
+# and the GLOBAL psum result obeys the same bound — when it fits the
+# narrow signed range, the narrowing cast, the integer psum and the
+# widening cast are all exact (BIT-identical to the int32 wire). The
+# int32 bound itself is tune_hist_psum's concern (it gates quant_psum).
+PSUM_WIRE_BOUNDS = (("int8", 2 ** 7), ("int16", 2 ** 15))
+
+
+def tune_psum_wire(*, n_rows_global: int, requested: int = -1) -> str:
+    """Wire dtype of the quantized histogram collective
+    (config.tpu_psum_wire): "int8"/"int16" when the 127*N wrap bound
+    proves the narrow sum cannot overflow, else "int32" (the legacy
+    wire). ``requested``: 0 = legacy int32; 1 = force-narrow (warns
+    and falls back to int32 where the bound refuses); -1 = auto
+    (narrowest provably-safe width — a pure bound check, no timing:
+    narrower is never slower and always bit-identical)."""
+    if requested == 0:
+        return "int32"
+    n = max(int(n_rows_global), 1)
+    for wire, bound in PSUM_WIRE_BOUNDS:
+        if 127 * n < bound:
+            return wire
+    if requested == 1:
+        log.warning("tpu_psum_wire=1 requested but %d global rows "
+                    "exceed every narrow wrap bound (127*N < 2^15 "
+                    "needed for int16); using the int32 wire", n)
+    return "int32"
+
+
+def tune_hist_psum_async(*, mesh, W: int, F: int, B: int,
+                         channels: int, wire: str = "f32",
+                         requested: int = -1) -> int:
+    """Slot count of the wave-histogram collective
+    (config.tpu_async_psum): 1 = one monolithic psum (sync);
+    2 = double-buffered slot collectives split along the feature axis
+    (parallel/learners.py make_hist_reduce), which XLA can overlap
+    with local compute. The split is BIT-identical for every wire
+    (psum is elementwise across shards), so the choice is purely a
+    scheduling/perf arm: -1 = auto (slots on multi-device meshes; the
+    async-vs-sync arm is timed once per (mesh, payload, device) key on
+    real TPUs, analytic default — async — elsewhere); 0 = sync;
+    1 = force async."""
+    if requested == 0:
+        return 1
+    if F < 2:
+        # nothing to split; the monolithic psum IS the slot psum
+        if requested == 1:
+            log.info("tpu_async_psum=1 with a single feature column: "
+                     "the collective has one slot either way")
+        return 1
+    if requested == 1:
+        return 2
+    if int(mesh.devices.size) < 2:
+        return 1
+    from ..utils.device import on_tpu
+    t = tuner()
+    if t.mode == "off" or not on_tpu():
+        return 2
+    key = {"D": int(mesh.devices.size), "W": W, "F": F, "B": B,
+           "C": channels, "wire": wire, "device": device_kind()}
+    cands = [{"slots": 1}, {"slots": 2}]
+    choice = t.best("hist_psum_async", key, cands,
+                    _psum_slots_measure_fn(mesh, (W, F, B, channels),
+                                           wire),
+                    default={"slots": 2})
+    return int(choice["slots"])
+
+
+def _psum_slots_measure_fn(mesh, shape, wire: str):
+    """measure(candidate) for the async-vs-sync arm: the real slot
+    split (parallel/learners.py) over a dummy payload, per slot
+    count."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.learners import _shard_map, _slot_psum
+
+    dtype = {"int8": jnp.int8, "int16": jnp.int16,
+             "int32": jnp.int32}.get(wire, jnp.float32)
+
+    def build(slots):
+        def body(x):
+            return _slot_psum(x, slots)
+        # jit-capture: ok(*) — throwaway psum microbenchmark body,
+        # closes over nothing but the mesh axis; never cached
+        f = jax.jit(_shard_map(body, mesh=mesh, in_specs=(P(),),
+                               out_specs=P(), check_vma=False))
+        x = jnp.ones(shape, dtype)
+        return functools.partial(f, x)
+
+    fns = {1: build(1), 2: build(2)}
+    return lambda cand: timing.measure(fns[cand["slots"]])
+
+
+def measure_psum_s(mesh, shape, dtype) -> float:
+    """Measured seconds per histogram-collective pass on THIS mesh for
+    the given payload — the stall-time estimate behind the
+    ``comm/psum_stall_s`` accounting (models/gbdt.py): per-pass
+    collective wall x pass count. A real measurement of the real
+    collective (not a bandwidth model), but taken outside the training
+    step — in-step timing would require host callbacks on the
+    compiled path."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.learners import AXIS, _shard_map
+
+    def body(x):
+        return jax.lax.psum(x, AXIS)
+    # jit-capture: ok(*) — throwaway psum microbenchmark body, closes
+    # over nothing but the mesh axis; never cached
+    f = jax.jit(_shard_map(body, mesh=mesh, in_specs=(P(),),
+                           out_specs=P(), check_vma=False))
+    x = jnp.ones(shape, dtype)
+    return float(timing.measure(functools.partial(f, x)))
+
+
 def _hist_measure_rows(cands: List[dict], F: int, bins_bytes: int) -> int:
     """Measurement row count: a multiple of every candidate chunk,
     capped so the synthetic bin matrix stays small."""

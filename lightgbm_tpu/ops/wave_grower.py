@@ -97,6 +97,23 @@ class WaveGrowerConfig(NamedTuple):
     # quantization scales are GLOBAL (max_reduce_fn = pmax), so the
     # scale factors commute with the cross-shard sum.
     quant_psum: bool = False
+    # packed psum wire (config.tpu_psum_wire, quant_psum only): dtype
+    # the quantized histogram payload crosses the collective in.
+    # "int32" is the legacy wire; "int16"/"int8" engage when the
+    # 127 * n_rows_global wrap bound proves the narrow sum exact
+    # (ops/autotune.py tune_psum_wire — the narrowing/widening casts
+    # and the integer psum are then all BIT-identical to int32). The
+    # field lives here, not just in the reduce closure, so the
+    # step-cache geometry key (models/gbdt.py _step_geometry_key)
+    # separates programs compiled for different wires.
+    psum_wire: str = "int32"
+    # overlap-structured collective (config.tpu_async_psum): number of
+    # independent slot psums the wave-histogram collective is split
+    # into along the feature axis (parallel/learners.py
+    # make_hist_reduce). 1 = one monolithic psum; 2 = double-buffered
+    # slots XLA can schedule against local compute. psum is
+    # elementwise across shards, so any slot count is bit-identical.
+    psum_slots: int = 1
     # sparse histogram tier (config.tpu_sparse, CSR-native datasets):
     # grow() receives ``bins_t`` as a TUPLE (dense [F, N] bins,
     # (codes, feat, row, zero_bins) coordinate planes) and wave
@@ -300,6 +317,19 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         # those as if they were the int32 wire would double-scale
         raise ValueError("quant_psum does not compose with injected "
                          "histogram/partition seams")
+    # the packed-wire/slot fields are CONSUMED by the data-parallel
+    # reduce closure (parallel/learners.py make_hist_reduce); they are
+    # validated here because this factory owns the config contract and
+    # the step-cache geometry key carries them
+    if cfg.psum_wire not in ("int8", "int16", "int32"):
+        raise ValueError(f"unknown psum_wire {cfg.psum_wire!r} "
+                         f"(want one of int8/int16/int32)")
+    if cfg.psum_wire != "int32" and not defer:
+        raise ValueError("a psum_wire narrower than int32 rides the "
+                         "quantized collective (quant_psum=True); the "
+                         "f32 wire cannot be narrowed exactly")
+    if cfg.psum_slots < 1:
+        raise ValueError(f"psum_slots={cfg.psum_slots} must be >= 1")
     if cfg.exact_variant not in ("hilo5", "hilo4", "hilo3"):
         raise ValueError(f"unknown exact_variant {cfg.exact_variant!r}")
     if cfg.exact_variant != "hilo5":

@@ -145,6 +145,65 @@ def _client():
         return None
 
 
+# KV key the autoscale controller (parallel/elastic.py) polls at LRB
+# window boundaries. A pod scheduler (or the drill) posts the DESIRED
+# world size here; workers see it at the next boundary and re-shard
+# through the checkpoint/restore path instead of dying. Env twin for
+# single-process/virtual-mesh runs where no coordination service
+# exists.
+_ELASTIC_PREFIX = "lgbm_tpu/elastic/"
+_ELASTIC_KEY = _ELASTIC_PREFIX + "target_world"
+ENV_TARGET_WORLD = "LGBM_TPU_TARGET_WORLD"
+
+
+def post_scale_signal(target_world: int) -> None:
+    """Publish the desired world size for elastic autoscaling. Under a
+    real cluster this lands in the coordination-service KV (visible to
+    every rank); single-process it sets the env twin so in-process
+    virtual-mesh controllers observe the same signal."""
+    client = _client()
+    if client is not None:
+        client.key_value_set(_ELASTIC_KEY, str(int(target_world)))
+    else:
+        os.environ[ENV_TARGET_WORLD] = str(int(target_world))
+
+
+def poll_scale_signal() -> Optional[int]:
+    """The posted target world size, or None when no signal (or an
+    unparsable one) is present. Non-blocking: the KV read is a dir
+    listing (the only non-blocking get the coordination client
+    offers — blocking_key_value_get would stall on an absent key)."""
+    client = _client()
+    raw = None
+    if client is not None:
+        try:
+            entries = client.key_value_dir_get(_ELASTIC_PREFIX)
+        except Exception:
+            entries = []
+        for key, value in entries:
+            if key == _ELASTIC_KEY or key.endswith("target_world"):
+                raw = value
+    if raw is None:
+        raw = os.environ.get(ENV_TARGET_WORLD)
+    try:
+        target = int(str(raw))
+    except (TypeError, ValueError):
+        return None
+    return target if target >= 1 else None
+
+
+def clear_scale_signal() -> None:
+    """Retire a consumed signal so the controller does not re-shard
+    again at the next boundary."""
+    client = _client()
+    if client is not None:
+        try:
+            client.key_value_delete(_ELASTIC_KEY)
+        except Exception:
+            pass
+    os.environ.pop(ENV_TARGET_WORLD, None)
+
+
 def _resolve_topology(config) -> tuple:
     """(world, rank, coordinator) from config knobs with env twins
     (a set-and-non-empty env wins — the launcher sets per-process

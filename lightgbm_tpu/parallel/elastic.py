@@ -149,6 +149,17 @@ def run_worker(spec: Dict) -> Dict:
             log.info("elastic resume: constructing dataset with the "
                      "checkpoint's %d bin mappers",
                      sum(1 for m in inject if not m.is_trivial))
+    if inject is None and spec.get("shared_binning"):
+        # the scaling bench compares MODELS across world sizes; the
+        # multihost bin finder samples per-host blocks, so its bin
+        # boundaries legitimately depend on the world. Pin them: every
+        # rank computes mappers from the full synthetic matrix it
+        # already holds — deterministic, world-independent, exactly
+        # what sharing a binning artifact does in production
+        from ..io.dataset import find_column_mappers
+        inject = find_column_mappers(X, cfg)
+        log.info("shared binning: %d mappers from the full matrix",
+                 sum(1 for m in inject if m is not None))
 
     if multi:
         from ..io.distributed import (DistributedLoader,
@@ -220,6 +231,20 @@ def run_worker(spec: Dict) -> Dict:
                          g.get_eval_at(0)).get("auc"))
     except Exception:
         pass
+    # DCN accounting for the scaling artifact: per-iteration psum
+    # payload bytes + the measured stall estimate (both None off the
+    # data-parallel path — e.g. the world-1 scaling point)
+    comm_per_iter = psum_stall = None
+    try:
+        _, waves = g.leaves_and_waves(0)
+        comm = g._comm_bytes_per_iteration(waves)
+        if comm:
+            comm_per_iter = int(round(sum(comm) / len(comm)))
+            passes = (sum(waves)
+                      + g.num_tree_per_iteration * len(waves))
+            psum_stall = g.psum_stall_estimate_s(passes)
+    except Exception as e:      # accounting never takes training down
+        log.debug("comm accounting skipped: %s", e)
     result = {
         "rank": cluster.rank(),
         "world": cluster.world(),
@@ -232,6 +257,13 @@ def run_worker(spec: Dict) -> Dict:
             obs.counter("ingest/rows_device").value
             or obs.counter("ingest/rows_host").value),
         "wall_s": round(time.monotonic() - t0, 3),
+        "wire": g.wire_encoding(),
+        "psum_slots": int(getattr(getattr(g, "_grower_cfg", None),
+                                  "psum_slots", 1) or 1),
+        "comm_bytes_per_iter": comm_per_iter,
+        "psum_stall_s": psum_stall,
+        "ckpt_hidden_s": (float(obs.counter("ckpt/hidden_s").value)
+                          or None),
     }
     if cluster.rank() == 0:
         if spec.get("model_out"):
@@ -532,6 +564,237 @@ def _strip_volatile(model_text: str) -> str:
     if lo < 0 or hi < 0:
         return model_text
     return model_text[:lo] + model_text[hi:]
+
+
+# -- elastic autoscale --------------------------------------------------------
+
+
+def train_autoscale(workdir: str, *, n: int = DRILL_N, f: int = DRILL_F,
+                    iterations: int = 12, window: int = 4,
+                    start_world: int = 2, seed: int = 0,
+                    schedule: Optional[Dict[int, int]] = None,
+                    extra_params: Optional[Dict] = None) -> Dict:
+    """The elastic autoscale controller: train in LRB window segments
+    and consult the scale signal (cluster.poll_scale_signal — a pod
+    scheduler's preemption notice or load target) at every window
+    boundary. On a world change the controller relies on the
+    checkpoints already on disk (the controller trains with
+    tpu_checkpoint_freq=1), tears down the segment's booster, and
+    resumes onto the NEW world size WITHOUT leaving the process: the
+    PR-15 restore path (mappers_from_bundle injection + resume_from)
+    turns the re-shard into a data-plane event instead of a job
+    restart. World sizes here are the ``num_machines`` virtual-mesh
+    cap (the in-process stand-in for real rank counts — a VOLATILE
+    knob, utils/checkpoint.py, so the fingerprint admits the resume);
+    the maneuver preserves the model bit-for-bit because the
+    quantized tier's histograms are mesh-size invariant
+    (tests/test_multichip.py).
+
+    ``schedule`` maps a boundary iteration to a target world; entries
+    are POSTED through cluster.post_scale_signal when that boundary is
+    reached, standing in for the external scheduler — the controller
+    itself only ever READS the signal.
+
+    Returns {model_text, worlds, reshards, iterations}.
+    """
+    from ..config import Config
+    from ..io.dataset import Metadata, TpuDataset
+    from ..metrics import create_metrics
+    from ..models.gbdt import GBDT
+    from ..objectives import create_objective
+    from ..obs import registry as obs
+    from ..utils import checkpoint as ckpt
+
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    X, y = _synth_data({"seed": seed, "n": n, "f": f})
+
+    world = max(int(start_world), 1)
+    worlds = [world]
+    reshards = 0
+    done = 0
+    model_text = ""
+    while done < iterations:
+        if schedule and done in schedule:
+            cluster.post_scale_signal(int(schedule[done]))
+        target = cluster.poll_scale_signal()
+        if target is not None:
+            cluster.clear_scale_signal()
+            if target != world:
+                if done > 0:
+                    reshards += 1
+                    obs.counter("elastic/reshard_total").add(1)
+                    log.info("elastic autoscale: re-sharding world "
+                             "%d -> %d at iteration %d (resume from "
+                             "%s)", world, target, done, ckpt_dir)
+                world = target
+                worlds.append(world)
+        end = min(done + window, iterations)
+        params = dict(DRILL_PARAMS)
+        params.update(extra_params or {})
+        params.update(
+            num_machines=world,
+            num_iterations=end,
+            tpu_checkpoint_dir=ckpt_dir,
+            tpu_checkpoint_freq=1)
+        cfg = Config().set(params)
+        inject = None
+        resume = ""
+        if done > 0:
+            resume = ckpt_dir
+            bundle = ckpt.resolve_resume(ckpt_dir)
+            inject = ckpt.mappers_from_bundle(bundle)
+        ds = TpuDataset(cfg).construct_from_matrix(
+            X, Metadata(label=y), mappers=inject)
+        obj = create_objective(cfg.objective, cfg)
+        obj.init(ds.metadata, ds.num_data)
+        mets = create_metrics(["auc"], cfg, ds.metadata, ds.num_data)
+        g = GBDT()
+        g.init(cfg, ds, obj, mets)
+        g.train(resume_from=resume)
+        got = int(g.current_iteration)
+        g._ensure_host_trees()
+        model_text = g.model_to_string()
+        if got <= done:     # early stop / no progress: don't spin
+            done = iterations
+            break
+        done = got
+    return {"model_text": model_text, "worlds": worlds,
+            "reshards": reshards, "iterations": done}
+
+
+def run_autoscale_drill(workdir: str, *, n: int = DRILL_N,
+                        iterations: int = 12, window: int = 4,
+                        worlds=(2, 4, 2), seed: int = 0,
+                        extra_params: Optional[Dict] = None) -> Dict:
+    """The grow-then-shrink proof: one uninterrupted run at
+    ``worlds[0]`` vs one autoscaled run that re-shards through every
+    world in ``worlds`` at successive window boundaries — final models
+    must match bit-for-bit (minus the volatile parameters block).
+    Returns the ``autoscale`` section of the MULTICHIP scaling
+    artifact; the artifact gate (tools/check_bench_regression.py) —
+    not an exception — is the parity arbiter."""
+    os.makedirs(workdir, exist_ok=True)
+    schedule = {window * (i + 1): int(w)
+                for i, w in enumerate(worlds[1:])}
+    cluster.clear_scale_signal()
+    try:
+        base = train_autoscale(
+            os.path.join(workdir, "baseline"), n=n,
+            iterations=iterations, window=iterations,
+            start_world=worlds[0], seed=seed,
+            extra_params=extra_params)
+        el = train_autoscale(
+            os.path.join(workdir, "elastic"), n=n,
+            iterations=iterations, window=window,
+            start_world=worlds[0], seed=seed, schedule=schedule,
+            extra_params=extra_params)
+    finally:
+        cluster.clear_scale_signal()
+    parity = (_strip_volatile(base["model_text"])
+              == _strip_volatile(el["model_text"]))
+    return {
+        "drill": "autoscale_grow_shrink",
+        "worlds": el["worlds"],
+        "window": window,
+        "iterations": iterations,
+        "reshard_total": el["reshards"],
+        "model_parity": parity,
+        "parity_kind": "bit_identical",
+    }
+
+
+def run_scaling_bench(workdir: str, *, world_sizes=(1, 2, 4),
+                      n: int = DRILL_N, iterations: int = 8,
+                      seed: int = 0,
+                      extra_params: Optional[Dict] = None,
+                      timeout_s: float = 900.0) -> List[Dict]:
+    """The measured scaling curve: train the identical workload at
+    each world size over REAL processes (launch_workers), collecting
+    throughput, per-iteration DCN bytes, the measured psum stall and
+    the checkpoint seconds hidden by the background writer. Model
+    texts (minus the volatile parameters block — world size and
+    artifact paths differ by construction) must agree across every
+    point; each point carries the stripped-text sha so the artifact
+    gate can arbitrate."""
+    points = []
+    for w in world_sizes:
+        wd = os.path.join(workdir, f"w{w}")
+        os.makedirs(wd, exist_ok=True)
+        spec = {
+            "seed": seed, "n": n, "f": DRILL_F,
+            "shared_binning": True,
+            "params": {**(extra_params or {}),
+                       "num_iterations": iterations},
+            "checkpoint_dir": os.path.join(wd, "ckpt"),
+            "out": os.path.join(wd, "result.json"),
+            "model_out": os.path.join(wd, "model.txt"),
+        }
+        spec_path = os.path.join(wd, "spec.json")
+        _write_json(spec_path, spec)
+        t0 = time.monotonic()
+        codes = wait_workers(launch_workers(spec_path, w, log_dir=wd),
+                             timeout_s)
+        wall = time.monotonic() - t0
+        if any(codes):
+            raise RuntimeError(
+                f"scaling bench world={w} failed: rc={codes}\n"
+                f"{_worker_tails(wd, w)}")
+        res = _read_json(spec["out"])
+        with open(spec["model_out"]) as fh:
+            sha = hashlib.sha256(
+                _strip_volatile(fh.read()).encode()).hexdigest()
+        train_wall = float(res.get("wall_s") or wall)
+        points.append({
+            "world": w,
+            "wall_s": train_wall,
+            "launch_wall_s": round(wall, 2),
+            "throughput_rows_per_s": round(
+                n * iterations / max(train_wall, 1e-9), 1),
+            "comm_bytes_per_iter": res.get("comm_bytes_per_iter"),
+            "psum_stall_s": res.get("psum_stall_s"),
+            "ckpt_hidden_s": res.get("ckpt_hidden_s"),
+            "wire": res.get("wire"),
+            "psum_slots": res.get("psum_slots"),
+            "model_sha": sha,
+        })
+    return points
+
+
+def run_scaling_artifact(workdir: str, *, world_sizes=(1, 2, 4),
+                         n: int = DRILL_N, iterations: int = 8,
+                         autoscale_window: int = 4,
+                         seed: int = 0,
+                         extra_params: Optional[Dict] = None) -> Dict:
+    """Assemble the full MULTICHIP scaling artifact
+    (schema lightgbm-tpu/multichip-scaling): the measured curve over
+    real process worlds plus the in-process grow-then-shrink autoscale
+    drill. This is what generates ``benchmarks/MULTICHIP_rNN.json``."""
+    points = run_scaling_bench(
+        os.path.join(workdir, "curve"), world_sizes=world_sizes, n=n,
+        iterations=iterations, seed=seed, extra_params=extra_params)
+    auto = run_autoscale_drill(
+        os.path.join(workdir, "autoscale"), n=n,
+        iterations=max(iterations, 3 * autoscale_window),
+        window=autoscale_window, seed=seed,
+        extra_params=extra_params)
+    shas = {p["model_sha"] for p in points}
+    hidden = [p["ckpt_hidden_s"] for p in points
+              if p.get("ckpt_hidden_s")]
+    return {
+        "schema": "lightgbm-tpu/multichip-scaling",
+        "version": 1,
+        "workload": {"n": n, "f": DRILL_F, "seed": seed,
+                     "iterations": iterations,
+                     "params": {**DRILL_PARAMS,
+                                **(extra_params or {})}},
+        "points": points,
+        "model_parity": len(shas) == 1,
+        "parity_kind": "bit_identical",
+        "checkpoint": {"hidden_s": (round(max(hidden), 4)
+                                    if hidden else None)},
+        "autoscale": auto,
+    }
 
 
 if __name__ == "__main__":

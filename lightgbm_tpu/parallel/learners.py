@@ -94,6 +94,67 @@ def _psum_seam(x):
     return ov(x, base) if ov is not None else base(x)
 
 
+# packed psum wire (config.tpu_psum_wire): the quantized histogram
+# payload is integer-valued, so inside the 127*N wrap bound it crosses
+# the collective in a narrow dtype — cast, psum, widen, all exact
+_WIRE_DTYPES = {"int8": jnp.int8, "int16": jnp.int16,
+                "int32": jnp.int32}
+
+
+def _slot_psum(x, slots: int, psum=_psum_seam):
+    """The overlap-structured histogram collective
+    (config.tpu_async_psum): split a [W, F, B, C] payload along the
+    feature axis into ``slots`` INDEPENDENT psums. psum is elementwise
+    across shards, so the slot split is BIT-identical to the monolithic
+    collective (for f32 and integer wires alike) — what it buys is
+    scheduling freedom: XLA can launch slot 0's DCN reduction while
+    slot 1's producer (and downstream per-slot consumers) still
+    occupy the cores, instead of stalling the whole step on one fused
+    collective. Payloads too small/low-rank to split fall back to the
+    single psum."""
+    slots = max(int(slots), 1)
+    if slots == 1 or x.ndim < 2 or x.shape[1] < slots:
+        return psum(x)
+    F = x.shape[1]
+    step = F // slots
+    parts = []
+    lo = 0
+    for s in range(slots):
+        hi = F if s == slots - 1 else lo + step
+        parts.append(psum(jax.lax.slice_in_dim(x, lo, hi, axis=1)))
+        lo = hi
+    return jnp.concatenate(parts, axis=1)
+
+
+def make_hist_reduce(cfg: WaveGrowerConfig):
+    """The data-parallel wave-histogram collective, assembled from the
+    config's wire + slot arms (both proven bit-identical to the plain
+    ``psum`` — see _slot_psum and the tune_psum_wire bound,
+    ops/autotune.py):
+
+    - wire (quant_psum only): the deferred-dequant payload is
+      integer-VALUED (int32 on the Pallas tier, integral f32 on the
+      XLA oracle), so the narrowing cast to cfg.psum_wire, the integer
+      psum and the widening cast back are all exact inside the 127*N
+      bound;
+    - slots: the feature axis splits into cfg.psum_slots independent
+      collectives XLA can overlap with local compute.
+    """
+    wire = _WIRE_DTYPES.get(cfg.psum_wire, jnp.int32)
+    narrow = bool(cfg.quant_psum) and cfg.psum_wire != "int32"
+    slots = max(int(cfg.psum_slots), 1)
+
+    def one(x):
+        if narrow and x.dtype != wire:
+            return _psum_seam(x.astype(wire)).astype(x.dtype)
+        return _psum_seam(x)
+
+    def hist_reduce(x):
+        return _slot_psum(x, slots, psum=one)
+
+    return hist_reduce
+
+
 _meshes_logged: set = set()
 
 
@@ -186,9 +247,16 @@ def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     partition+histogram Pallas kernel stays live per shard — on a real
     mesh each chip runs the same single-chip kernel on its rows and
     only the [W, F, B, 3] histograms cross ICI.
+
+    The histogram collective itself is built by ``make_hist_reduce``
+    from the config's packed-wire + slot arms (tpu_psum_wire /
+    tpu_async_psum) — bit-identical to the plain psum by construction;
+    scalar reductions (root aggregates) keep the plain seam.
     """
     def reduce_fn(x):
         return _psum_seam(x)
+
+    hist_reduce_fn = make_hist_reduce(cfg)
 
     def max_reduce_fn(x):
         # global int8 quantization scales: every shard must quantize
@@ -208,7 +276,7 @@ def make_data_parallel_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     # histograms its own rows through it, then the expanded [W, F, B, 3]
     # rides the psum exactly like the default seam's output
     grow = make_wave_grower(cfg, meta, hist_fn=hist_fn,
-                            hist_reduce_fn=reduce_fn,
+                            hist_reduce_fn=hist_reduce_fn,
                             reduce_fn=reduce_fn,
                             max_reduce_fn=max_reduce_fn,
                             row_offset_fn=row_offset_fn, jit=False)

@@ -87,9 +87,18 @@ VOLATILE_KNOBS = frozenset({
     # cluster topology (parallel/cluster.py): ELASTIC resume is the
     # whole point — a checkpoint written by a 4-process run must
     # restore under 2 processes (or 1) without a fingerprint refusal,
-    # and every process carries its own rank
-    "tpu_num_machines", "tpu_machine_rank", "tpu_coordinator",
-    "tpu_collective_timeout_s",
+    # and every process carries its own rank. num_machines (the
+    # reference alias, doubling as the in-process virtual-mesh cap) is
+    # topology too: the autoscale controller (parallel/elastic.py)
+    # re-shards across it at window boundaries
+    "num_machines", "tpu_num_machines", "tpu_machine_rank",
+    "tpu_coordinator", "tpu_collective_timeout_s",
+    # transport/scheduling knobs (parallel/learners.py packed wire,
+    # slot psum; this module's background writer): every setting is
+    # proven BIT-identical to its synchronous/wide twin, so none shape
+    # the training math — a checkpoint written under int16 wire +
+    # async slots restores under the legacy wire and vice versa
+    "tpu_psum_wire", "tpu_async_psum", "tpu_ckpt_async",
 })
 
 
@@ -288,14 +297,184 @@ def _geometry_summary(booster) -> dict:
 
 # -- bundle IO ---------------------------------------------------------------
 
-def save_checkpoint(booster, directory: str,
-                    keep: int = 3) -> Optional[str]:
+def _commit_bundle(directory: str, path: str, arrays: dict,
+                   bundle: dict, keep: int) -> str:
+    """The host-local write phase: scores sidecar FIRST, bundle second
+    (the bundle is the commit point), prune, count. Runs on the
+    caller's thread for synchronous checkpoints and on the
+    AsyncCheckpointWriter thread for background ones — commit-point
+    ordering is identical either way."""
+    with atomic_write(scores_path(path), mode="wb") as fh:
+        np.savez_compressed(fh, **arrays)
+    with atomic_write(path) as fh:
+        json.dump(bundle, fh)
+    prune_checkpoints(directory, keep)
+    from ..obs import registry as obs
+    obs.counter("checkpoint/writes").add(1)
+    log.info("checkpoint written: %s (iteration %d, keep %d)",
+             path, int(bundle["iteration"]), keep)
+    return path
+
+
+class AsyncCheckpointWriter:
+    """Bounded-queue background writer for checkpoint bundles
+    (tpu_ckpt_async): the COLLECTIVE score gather and the host-side
+    bundle construction stay on the training thread (save_checkpoint);
+    only the serialization + atomic file writes — the slow,
+    filesystem-bound tail — run here, off the critical path.
+
+    Semantics preserved from the synchronous path:
+
+    - commit-point ordering: jobs run strictly in submission order on
+      ONE thread, and each job writes sidecar-then-bundle via
+      atomic_write, so a crash (even SIGKILL mid-write) never leaves a
+      torn bundle and the newest complete bundle is always a valid
+      restart point;
+    - ``checkpoint/write_failures``: a failed background write warns
+      and bumps the same counter the synchronous path does — training
+      never stops for a full disk;
+    - a full queue drops the OLDEST not-yet-started job (the newer
+      checkpoint supersedes it — exactly what prune would do moments
+      later) instead of blocking the training thread.
+
+    ``drain()`` must run at train end and before any resume read
+    (resolve_resume calls ``drain_writers()`` itself as a backstop).
+    """
+
+    def __init__(self, maxsize: int = 2):
+        import collections
+        import threading
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._jobs: "collections.deque" = \
+            collections.deque()        # guarded-by: _lock
+        self._maxsize = max(int(maxsize), 1)
+        self._busy = False             # guarded-by: _lock
+        self._closed = False           # guarded-by: _lock
+        self._failures = 0             # guarded-by: _lock
+        self._write_s = 0.0            # guarded-by: _lock
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def submit(self, directory: str, path: str, arrays: dict,
+               bundle: dict, keep: int) -> bool:
+        """Enqueue one write job; never blocks on a slow disk."""
+        from ..obs import registry as obs
+        with self._lock:
+            if self._closed:
+                return False
+            if len(self._jobs) >= self._maxsize:
+                dropped = self._jobs.popleft()
+                log.debug("checkpoint writer queue full: dropping "
+                          "queued write %s (superseded by %s)",
+                          dropped[1], path)
+            self._jobs.append((directory, path, arrays, bundle, keep))
+            obs.gauge("ckpt/queue_depth").set(len(self._jobs))
+            self._wake.notify_all()
+        return True
+
+    def _run(self) -> None:
+        from ..obs import registry as obs
+        while True:
+            with self._lock:
+                while not self._jobs and not self._closed:
+                    self._wake.wait()
+                if not self._jobs and self._closed:
+                    return
+                job = self._jobs.popleft()
+                self._busy = True
+                obs.gauge("ckpt/queue_depth").set(len(self._jobs))
+            t0 = time.monotonic()
+            try:
+                _commit_bundle(job[0], job[1], job[2], job[3], job[4])
+            except Exception as e:       # same downgrade as the sync
+                # path's caller: warn + count, never stop training
+                obs.counter("checkpoint/write_failures").add(1)
+                log.warning("background checkpoint write failed "
+                            "(training continues): %s", e)
+                with self._lock:
+                    self._failures += 1
+            finally:
+                dt = time.monotonic() - t0
+                obs.counter("ckpt/hidden_s").add(dt)
+                with self._lock:
+                    self._busy = False
+                    self._write_s += dt
+                    self._wake.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted job has committed (or failed).
+        True = drained; False = timed out with work still pending."""
+        import time as _time
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        with self._lock:
+            while self._jobs or self._busy:
+                rem = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if rem is not None and rem <= 0:
+                    return False
+                self._wake.wait(rem)
+        return True
+
+    def close(self, timeout: Optional[float] = None) -> bool:
+        """Drain, then stop the thread. Safe to call twice."""
+        ok = self.drain(timeout)
+        with self._lock:
+            self._closed = True
+            self._wake.notify_all()
+        self._thread.join(timeout)
+        return ok and not self._thread.is_alive()
+
+    @property
+    def failures(self) -> int:
+        with self._lock:
+            return self._failures
+
+    @property
+    def write_seconds(self) -> float:
+        """Total seconds of write work hidden from the training path."""
+        with self._lock:
+            return self._write_s
+
+
+# every live writer, so resolve_resume can drain pending writes it
+# did not create (a resume may read a directory another booster in
+# this process is still writing to)
+_writers: List[AsyncCheckpointWriter] = []   # guarded-by: _writers_lock
+import threading as _threading
+_writers_lock = _threading.Lock()
+
+
+def new_writer(maxsize: int = 2) -> AsyncCheckpointWriter:
+    w = AsyncCheckpointWriter(maxsize=maxsize)
+    with _writers_lock:
+        _writers.append(w)
+    return w
+
+
+def drain_writers(timeout: Optional[float] = None) -> None:
+    """Drain every live background writer — called at train end and
+    before any resume read, so a resume never races a pending write."""
+    with _writers_lock:
+        ws = list(_writers)
+    for w in ws:
+        w.drain(timeout)
+
+
+def save_checkpoint(booster, directory: str, keep: int = 3,
+                    writer: Optional[AsyncCheckpointWriter] = None,
+                    ) -> Optional[str]:
     """Write ``ckpt_iter_<N>.scores.npz`` then ``ckpt_iter_<N>.json``
     (the bundle is the commit point) and prune to ``keep``; returns
     the bundle path. Raises on failure — the caller (the training
     loop) downgrades that to a warning so a full disk never takes
     training down, and the atomic writes guarantee the previous
-    complete checkpoint survives."""
+    complete checkpoint survives. With ``writer`` the file writes are
+    handed to the background writer thread (gather + bundle
+    construction still happen here, on-path — the collective part and
+    the snapshot-consistent view of the booster's mutable state)."""
     from ..parallel import cluster
     eff = booster._effective_num_models()
     if eff != len(booster.models):
@@ -360,16 +539,10 @@ def save_checkpoint(booster, directory: str,
         "scores_file": os.path.basename(scores_path(path)),
         "model": booster.model_to_string(),
     }
-    with atomic_write(scores_path(path), mode="wb") as fh:
-        np.savez_compressed(fh, **arrays)
-    with atomic_write(path) as fh:
-        json.dump(bundle, fh)
-    prune_checkpoints(directory, keep)
-    from ..obs import registry as obs
-    obs.counter("checkpoint/writes").add(1)
-    log.info("checkpoint written: %s (iteration %d, keep %d)",
-             path, it, keep)
-    return path
+    if writer is not None:
+        writer.submit(directory, path, arrays, bundle, keep)
+        return path
+    return _commit_bundle(directory, path, arrays, bundle, keep)
 
 
 def load_checkpoint(path: str) -> dict:
@@ -420,7 +593,10 @@ def resolve_resume(path_or_dir: str) -> dict:
     """A checkpoint file loads directly; a directory resolves to its
     NEWEST valid checkpoint — corrupt/newer-layout bundles are skipped
     with a warning (a crash mid-write plus atomic_write means the
-    newest complete one is the right restart point)."""
+    newest complete one is the right restart point). Pending
+    background writes are drained FIRST, so a resume in the same
+    process never reads past a checkpoint still in a writer queue."""
+    drain_writers()
     if os.path.isdir(path_or_dir):
         entries = list_checkpoints(path_or_dir)
         if not entries:

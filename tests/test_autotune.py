@@ -217,6 +217,75 @@ class TestDefaultsOffTpu:
         assert cfg.tpu_autotune == "exhaustive"
         assert cfg.tpu_tuning_cache == "/tmp/x.json"
 
+    def test_overlap_knob_validation(self):
+        """(PR16) the three overlap knobs are tri-state -1/0/1 and
+        clamp anything else back to auto."""
+        from lightgbm_tpu.config import Config
+        cfg = Config()
+        assert (cfg.tpu_psum_wire, cfg.tpu_async_psum,
+                cfg.tpu_ckpt_async) == (-1, -1, -1)
+        cfg = Config().set({"tpu_psum_wire": 0, "tpu_async_psum": 1,
+                            "tpu_ckpt_async": 0})
+        assert (cfg.tpu_psum_wire, cfg.tpu_async_psum,
+                cfg.tpu_ckpt_async) == (0, 1, 0)
+        cfg = Config().set({"tpu_psum_wire": 7, "tpu_async_psum": -3,
+                            "tpu_ckpt_async": "2"})
+        assert (cfg.tpu_psum_wire, cfg.tpu_async_psum,
+                cfg.tpu_ckpt_async) == (-1, -1, -1)
+
+
+class TestPsumWire:
+    """(PR16) the packed-wire and async-psum tuner arms: pure bound
+    checks / analytic defaults off-TPU, so fully deterministic here."""
+
+    def test_wire_bound_selects_narrowest_safe(self):
+        # 127*N < 2^7 only for N=1; 127*N < 2^15 up to N=258
+        assert autotune.tune_psum_wire(n_rows_global=1) == "int8"
+        assert autotune.tune_psum_wire(n_rows_global=200) == "int16"
+        assert autotune.tune_psum_wire(n_rows_global=258) == "int16"
+        assert autotune.tune_psum_wire(n_rows_global=259) == "int32"
+        assert autotune.tune_psum_wire(n_rows_global=4096) == "int32"
+
+    def test_wire_requested_zero_is_legacy(self):
+        assert autotune.tune_psum_wire(
+            n_rows_global=1, requested=0) == "int32"
+
+    def test_wire_force_narrow_refuses_on_wrap_bound(self):
+        """tpu_psum_wire=1 cannot override the overflow proof: the
+        refusal falls back to int32 and says why."""
+        from lightgbm_tpu.utils import log as tpulog
+        lines = []
+        tpulog.add_sink(lines.append)
+        try:
+            got = autotune.tune_psum_wire(n_rows_global=4096,
+                                          requested=1)
+        finally:
+            tpulog.remove_sink(lines.append)
+        assert got == "int32"
+        assert any("wrap bound" in ln for ln in lines)
+
+    def _mesh(self, n):
+        from lightgbm_tpu.parallel.learners import make_mesh
+        from lightgbm_tpu.utils.device import get_devices
+        return make_mesh(min(n, len(get_devices())))
+
+    def test_async_arm_decisions(self):
+        mesh2 = self._mesh(2)
+        kw = dict(mesh=mesh2, W=8, F=4, B=64, channels=3)
+        # requested sync / async win outright
+        assert autotune.tune_hist_psum_async(requested=0, **kw) == 1
+        assert autotune.tune_hist_psum_async(requested=1, **kw) == 2
+        # auto: analytic default (async) off-TPU on a real mesh
+        assert autotune.tune_hist_psum_async(requested=-1, **kw) == 2
+        # single feature column: nothing to split
+        assert autotune.tune_hist_psum_async(
+            mesh=mesh2, W=8, F=1, B=64, channels=3, requested=1) == 1
+
+    def test_async_arm_single_device_mesh_stays_sync(self):
+        mesh1 = self._mesh(1)
+        assert autotune.tune_hist_psum_async(
+            mesh=mesh1, W=8, F=4, B=64, channels=3, requested=-1) == 1
+
 
 class TestTunedParity:
     """A tuned tile choice may never change results beyond documented

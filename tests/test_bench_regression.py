@@ -600,6 +600,136 @@ def test_multichip_drill_survivor_and_rows_gates():
     assert any("dropped" in r for r in regressions)
 
 
+def _scaling_doc(**kw):
+    d = {
+        "schema": cbr.MULTICHIP_SCALING_SCHEMA, "version": 1,
+        "workload": {"n": 2048, "f": 8, "iterations": 6},
+        "points": [
+            {"world": 1, "throughput_rows_per_s": 1700.0,
+             "comm_bytes_per_iter": None, "psum_stall_s": None,
+             "ckpt_hidden_s": 0.03, "wire": "", "psum_slots": 1,
+             "model_sha": "aa"},
+            {"world": 2, "throughput_rows_per_s": 900.0,
+             "comm_bytes_per_iter": 172032, "psum_stall_s": 0.02,
+             "ckpt_hidden_s": 0.04, "wire": "int32", "psum_slots": 2,
+             "model_sha": "aa"},
+        ],
+        "model_parity": True, "parity_kind": "bit_identical",
+        "checkpoint": {"hidden_s": 0.04},
+        "autoscale": {"drill": "autoscale_grow_shrink",
+                      "worlds": [2, 4, 2], "window": 3,
+                      "iterations": 9, "reshard_total": 2,
+                      "model_parity": True,
+                      "parity_kind": "bit_identical"},
+    }
+    d.update(kw)
+    return d
+
+
+def test_multichip_scaling_pass_and_cli(tmp_path):
+    schema, regressions, notes = cbr.check_multichip_scaling(
+        _scaling_doc())
+    assert schema == [] and regressions == []
+    assert any("hidden" in n for n in notes)
+    p = tmp_path / "scaling.json"
+    p.write_text(json.dumps(_scaling_doc()))
+    assert cbr.main([str(p)]) == 0
+
+
+def test_multichip_scaling_parity_and_reshard_regressions():
+    _, regressions, _ = cbr.check_multichip_scaling(
+        _scaling_doc(model_parity=False))
+    assert any("mesh-size invariance" in r for r in regressions)
+    doc = _scaling_doc()
+    doc["autoscale"]["model_parity"] = False
+    _, regressions, _ = cbr.check_multichip_scaling(doc)
+    assert any("elastic autoscale is broken" in r for r in regressions)
+    doc = _scaling_doc()
+    doc["autoscale"]["reshard_total"] = 0
+    _, regressions, _ = cbr.check_multichip_scaling(doc)
+    assert any("never" in r for r in regressions)
+
+
+def test_multichip_scaling_schema_refusals(tmp_path):
+    assert cbr.check_multichip_scaling(
+        _scaling_doc(version=2))[0]
+    assert cbr.check_multichip_scaling(
+        _scaling_doc(points=[]))[0]
+    doc = _scaling_doc()
+    doc["points"] = list(reversed(doc["points"]))     # worlds 2, 1
+    assert any("strictly increasing" in s for s in
+               cbr.check_multichip_scaling(doc)[0])
+    doc = _scaling_doc()
+    doc["points"][1]["psum_stall_s"] = "fast"
+    assert any("psum_stall_s" in s for s in
+               cbr.check_multichip_scaling(doc)[0])
+    doc = _scaling_doc()
+    del doc["autoscale"]
+    assert any("autoscale" in s for s in
+               cbr.check_multichip_scaling(doc)[0])
+    # the CLI maps a schema refusal to exit 2
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(_scaling_doc(points=[])))
+    assert cbr.main([str(p)]) == 2
+
+
+def test_multichip_r07_artifact_passes_gate():
+    """The committed MULTICHIP_r07 artifact (the real measured scaling
+    curve + autoscale drill) must stay green through its own gate."""
+    path = os.path.join(REPO, "MULTICHIP_r07.json")
+    assert cbr.main([path]) == 0
+    doc = json.loads(open(path).read())
+    assert doc["model_parity"] is True
+    assert doc["autoscale"]["model_parity"] is True
+    assert doc["autoscale"]["reshard_total"] >= 1
+    assert [p["world"] for p in doc["points"]] == [1, 2, 4]
+    shas = {p["model_sha"] for p in doc["points"]}
+    assert len(shas) == 1, "scaling points trained different models"
+
+
+def test_baseline_flag_and_shape_aware_selection(tmp_path):
+    """(PR16) trajectory baseline selection: a point flagged
+    ``"baseline": false`` (the quick-shape r06 ledger entry) never
+    becomes the comparison floor, and among eligible points the gate
+    prefers the newest one whose metric string MATCHES the fresh
+    run's workload shape."""
+    full = "11M rows x 28 feat"
+    quick = "65536 rows x 28 feat"
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        _fresh(metric=full, value=50.0)))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        _fresh(metric=quick, value=400.0, baseline=False)))
+    fresh = tmp_path / "fresh.json"
+    # full-size fresh: compares against r01 (r02 is flagged off), so a
+    # value that would be a crash vs r02's 400 still passes vs 50
+    fresh.write_text(json.dumps(_fresh(metric=full, value=49.0)))
+    assert cbr.main([str(fresh), "--baseline-dir",
+                     str(tmp_path)]) == 0
+    # quick-shape fresh: no eligible matching-metric point -> the gate
+    # refuses the cross-shape comparison instead of passing it
+    fresh.write_text(json.dumps(_fresh(metric=quick, value=400.0)))
+    assert cbr.main([str(fresh), "--baseline-dir",
+                     str(tmp_path)]) == 2
+    # un-flag r02: now the quick shape has a true baseline and passes
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        _fresh(metric=quick, value=400.0)))
+    assert cbr.main([str(fresh), "--baseline-dir",
+                     str(tmp_path)]) == 0
+    # and the full shape still walks back to r01 over the newer r02
+    fresh.write_text(json.dumps(_fresh(metric=full, value=49.0)))
+    assert cbr.main([str(fresh), "--baseline-dir",
+                     str(tmp_path)]) == 0
+
+
+def test_all_baselines_flagged_off_is_a_refusal(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        _fresh(baseline=False)))
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(_fresh()))
+    assert cbr.main([str(fresh), "--baseline-dir",
+                     str(tmp_path)]) == 2
+
+
 def test_multichip_r06_artifact_passes_gate():
     """The committed MULTICHIP_r06 artifact (the real drill run) must
     stay green through its own gate."""

@@ -423,6 +423,109 @@ def test_resume_bit_parity_sharded_state(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# background checkpoint writer (tpu_ckpt_async)
+# ---------------------------------------------------------------------------
+
+def _writer_job(directory, it):
+    """A minimal but schema-valid (bundle, sidecar) write job — enough
+    for load_checkpoint to accept the result."""
+    path = os.path.join(str(directory), f"ckpt_iter_{it}.json")
+    arrays = {"train": np.zeros(3, np.float32)}
+    bundle = {"schema": ckpt.CHECKPOINT_SCHEMA,
+              "version": ckpt.CHECKPOINT_VERSION,
+              "iteration": it, "model": "", "state": {},
+              "config_hash": "x",
+              "scores_file": os.path.basename(ckpt.scores_path(path))}
+    return (str(directory), path, arrays, bundle, 10)
+
+
+def test_async_writer_commits_in_order_and_drains(tmp_path):
+    w = ckpt.AsyncCheckpointWriter()
+    try:
+        assert w.submit(*_writer_job(tmp_path, 3))
+        assert w.submit(*_writer_job(tmp_path, 6))
+        assert w.drain(timeout=30)
+        for it in (3, 6):
+            b = ckpt.load_checkpoint(
+                str(tmp_path / f"ckpt_iter_{it}.json"))
+            assert int(b["iteration"]) == it
+        assert w.failures == 0
+        assert w.write_seconds > 0
+        assert obs.gauge("ckpt/queue_depth").value == 0
+    finally:
+        assert w.close(timeout=10)
+    assert not w.submit(*_writer_job(tmp_path, 9))    # closed refuses
+
+
+def test_async_writer_full_queue_drops_oldest(tmp_path, monkeypatch):
+    import threading
+    started, release, wrote = (threading.Event(), threading.Event(),
+                               [])
+
+    def stalling(directory, path, arrays, bundle, keep):
+        wrote.append(int(bundle["iteration"]))
+        started.set()
+        release.wait(10)
+        return path
+
+    monkeypatch.setattr(ckpt, "_commit_bundle", stalling)
+    w = ckpt.AsyncCheckpointWriter(maxsize=1)
+    try:
+        w.submit(*_writer_job(tmp_path, 1))       # in flight
+        assert started.wait(10)
+        w.submit(*_writer_job(tmp_path, 2))       # queued
+        w.submit(*_writer_job(tmp_path, 3))       # full: 2 dropped
+        release.set()
+        assert w.drain(timeout=30)
+        assert wrote == [1, 3]                    # superseded job gone
+    finally:
+        w.close(timeout=10)
+
+
+def test_async_writer_failure_warns_and_training_continues(
+        tmp_path, monkeypatch):
+    real = ckpt._commit_bundle
+
+    def broken(*a):
+        raise RuntimeError("disk full")
+
+    f0 = counter("checkpoint/write_failures")
+    monkeypatch.setattr(ckpt, "_commit_bundle", broken)
+    w = ckpt.AsyncCheckpointWriter()
+    try:
+        w.submit(*_writer_job(tmp_path, 3))
+        assert w.drain(timeout=30)
+        assert w.failures == 1
+        assert counter("checkpoint/write_failures") - f0 == 1
+        monkeypatch.setattr(ckpt, "_commit_bundle", real)
+        w.submit(*_writer_job(tmp_path, 6))       # writer survives
+        assert w.drain(timeout=30)
+        assert ckpt.load_checkpoint(
+            str(tmp_path / "ckpt_iter_6.json"))["iteration"] == 6
+    finally:
+        w.close(timeout=10)
+
+
+def test_resolve_resume_drains_pending_background_writes(
+        tmp_path, monkeypatch):
+    import time as _time
+    real = ckpt._commit_bundle
+
+    def delayed(*a):
+        _time.sleep(0.3)
+        return real(*a)
+
+    monkeypatch.setattr(ckpt, "_commit_bundle", delayed)
+    w = ckpt.new_writer()                 # registered: resolve_resume
+    try:                                  # must drain it itself
+        w.submit(*_writer_job(tmp_path, 9))
+        b = ckpt.resolve_resume(str(tmp_path))
+        assert int(b["iteration"]) == 9
+    finally:
+        w.close(timeout=10)
+
+
+# ---------------------------------------------------------------------------
 # kill-and-resume subprocess drill
 # ---------------------------------------------------------------------------
 
@@ -459,6 +562,9 @@ params = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
           "bagging_freq": 3, "bagging_fraction": 0.7,
           "tree_learner": learner,
           "tpu_checkpoint_dir": outdir, "tpu_checkpoint_freq": 3}
+import json
+params.update(json.loads(os.environ.get("LGBM_TPU_TEST_EXTRA_PARAMS",
+                                        "{}")))
 cfg = Config().set(params)
 ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
 obj = create_objective(cfg.objective, cfg)
@@ -517,6 +623,42 @@ def test_kill_and_resume_bit_parity_subprocess(child_script, tmp_path):
 @pytest.mark.slow
 def test_kill_and_resume_bit_parity_sharded(child_script, tmp_path):
     _kill_resume_drill(child_script, str(tmp_path), "data")
+
+
+def test_kill_and_resume_async_writer_no_torn_bundle(child_script,
+                                                     tmp_path):
+    """(PR16) the kill drill with the BACKGROUND writer on: SIGKILL can
+    land with a write still in the writer queue or mid-flight, but
+    atomic_write + sidecar-then-bundle ordering hold on the writer
+    thread too — every bundle on disk must load cleanly (no torn
+    bundle) and the newest one must resume bit-identically. Separate
+    dirs keep the killed run's checkpoints unpolluted by the
+    baseline's."""
+    plain_dir = str(tmp_path / "plain")
+    kill_dir = str(tmp_path / "kill")
+    os.makedirs(plain_dir)
+    os.makedirs(kill_dir)
+    async_env = {"LGBM_TPU_TEST_EXTRA_PARAMS": '{"tpu_ckpt_async": 1}'}
+    r = _run_child(child_script, "plain", plain_dir,
+                   extra_env=async_env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = _run_child(child_script, "kill", kill_dir,
+                   extra_env=dict(async_env,
+                                  LGBM_TPU_FAULTS="train.iter@9:kill"))
+    assert r.returncode == -signal.SIGKILL, \
+        f"child was not SIGKILLed (rc={r.returncode}): {r.stderr[-500:]}"
+    entries = ckpt.list_checkpoints(kill_dir)
+    assert entries, "killed run left no checkpoints"
+    for _, p in entries:
+        ckpt.load_checkpoint(p)          # schema + sidecar intact
+    r = _run_child(child_script, "resume", kill_dir,
+                   extra_env=async_env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    plain = open(os.path.join(plain_dir, "model_plain.txt")).read()
+    resumed = open(os.path.join(kill_dir, "model_resume.txt")).read()
+    assert resumed == plain, \
+        "kill->resume with the async writer did not reproduce the " \
+        "uninterrupted model"
 
 
 # ---------------------------------------------------------------------------

@@ -226,6 +226,42 @@ class TestQuantizedPsum:
         with pytest.raises(ValueError, match="int8"):
             make_wave_grower(bad, meta)
 
+    def test_packed_wire_bit_parity(self):
+        """(PR16) the narrow psum wire: with few enough global rows the
+        127*N wrap bound proves an int16 (even int8) payload cannot
+        overflow, so cast -> narrow psum -> widen is EXACT and the
+        model must match the int32 wire byte for byte."""
+        from lightgbm_tpu.parallel.elastic import _strip_volatile
+        X, y = make_binary(256, seed=11)
+        base = {"objective": "binary", "metric": "auc",
+                "tpu_quantized_hist": True, "tree_learner": "data",
+                "tpu_quantized_psum": 1, "min_data_in_leaf": 5}
+        g32 = fit_gbdt(X, y, dict(base, tpu_psum_wire=0), num_round=5)
+        gnw = fit_gbdt(X, y, dict(base, tpu_psum_wire=-1), num_round=5)
+        assert g32.wire_encoding() == "int32"
+        assert gnw.wire_encoding() in ("int8", "int16")
+        assert _strip_volatile(gnw.model_to_string()) \
+            == _strip_volatile(g32.model_to_string())
+
+    def test_async_slot_psum_bit_parity(self):
+        """(PR16) the double-buffered slot collective splits the psum
+        along the feature axis — pure scheduling freedom, elementwise
+        across shards, so the model is bit-identical to the monolithic
+        (sync) collective."""
+        from lightgbm_tpu.parallel.elastic import _strip_volatile
+        X, y = make_binary(1282, seed=7)
+        base = {"objective": "binary", "metric": "auc",
+                "tpu_quantized_hist": True, "tree_learner": "data",
+                "tpu_quantized_psum": 1}
+        gsync = fit_gbdt(X, y, dict(base, tpu_async_psum=0),
+                         num_round=5)
+        gasync = fit_gbdt(X, y, dict(base, tpu_async_psum=1),
+                          num_round=5)
+        assert gsync._grower_cfg.psum_slots == 1
+        assert gasync._grower_cfg.psum_slots == 2
+        assert _strip_volatile(gasync.model_to_string()) \
+            == _strip_volatile(gsync.model_to_string())
+
 
 class TestReporting:
     """Mesh size + comm bytes surface through the public API and the

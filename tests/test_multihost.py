@@ -314,3 +314,57 @@ def test_elastic_drill_end_to_end(tmp_path):
     import check_bench_regression as cbr
     schema, regressions, _ = cbr.check_multichip_drill(out)
     assert schema == [] and regressions == []
+
+
+# ---------------------------------------------------------------------------
+# (PR16) elastic autoscale at window boundaries
+# ---------------------------------------------------------------------------
+
+def test_scale_signal_roundtrip_and_garbage():
+    """Single-process: the scale signal rides the env twin of the
+    coordinator KV — post/poll/clear roundtrip, and unparsable or
+    nonsensical targets read as 'no signal'."""
+    cluster.clear_scale_signal()
+    try:
+        assert cluster.poll_scale_signal() is None
+        cluster.post_scale_signal(4)
+        assert cluster.poll_scale_signal() == 4
+        cluster.clear_scale_signal()
+        assert cluster.poll_scale_signal() is None
+        os.environ[cluster.ENV_TARGET_WORLD] = "not-a-world"
+        assert cluster.poll_scale_signal() is None
+        os.environ[cluster.ENV_TARGET_WORLD] = "0"
+        assert cluster.poll_scale_signal() is None
+    finally:
+        cluster.clear_scale_signal()
+
+
+def test_autoscale_smoke_grows_at_window_boundary(tmp_path):
+    """In-process autoscale smoke: one scheduled grow (virtual world
+    2 -> 4 over the 8-device mesh) lands exactly at the window
+    boundary via checkpoint + re-shard + resume, without leaving the
+    process. Full parity is the slow drill's job."""
+    from lightgbm_tpu.obs import registry as obs
+    r0 = int(obs.counter("elastic/reshard_total").value)
+    out = elastic.train_autoscale(str(tmp_path), n=512, iterations=4,
+                                  window=2, start_world=2,
+                                  schedule={2: 4})
+    assert out["worlds"] == [2, 4]
+    assert out["reshards"] == 1
+    assert out["iterations"] == 4
+    assert "tree" in out["model_text"]
+    assert int(obs.counter("elastic/reshard_total").value) - r0 == 1
+
+
+@pytest.mark.slow
+def test_autoscale_grow_shrink_drill_bit_identical(tmp_path):
+    """The acceptance drill: grow 2 -> 4 then shrink 4 -> 2 at window
+    boundaries, final model BIT-identical to an uninterrupted
+    fixed-world run — no process restart anywhere."""
+    out = elastic.run_autoscale_drill(str(tmp_path), n=1024,
+                                      iterations=9, window=3,
+                                      worlds=(2, 4, 2))
+    assert out["model_parity"] is True
+    assert out["parity_kind"] == "bit_identical"
+    assert out["reshard_total"] == 2
+    assert out["worlds"] == [2, 4, 2]

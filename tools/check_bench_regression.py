@@ -76,6 +76,11 @@ def load_bench(doc) -> dict:
         with open(doc) as fh:
             doc = json.load(fh)
     out = dict(doc.get("parsed") or doc)
+    # the wrapper-level baseline flag must survive normalization:
+    # "baseline": false marks a ledger-only point (e.g. a quick-shape
+    # parity snapshot) that must never become the trajectory floor
+    if "baseline" in doc:
+        out["baseline"] = doc["baseline"]
     tail = doc.get("tail", "")
     if tail and ("test_auc" not in out or out.get("test_auc") is None):
         m = _TAIL_AUC_RE.search(tail)
@@ -625,6 +630,127 @@ def check_multichip_drill(doc: dict) -> tuple:
     return schema, regressions, notes
 
 
+MULTICHIP_SCALING_SCHEMA = "lightgbm-tpu/multichip-scaling"
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def check_multichip_scaling(doc: dict) -> tuple:
+    """(schema_problems, regressions, notes) for a scaling-curve
+    artifact (parallel/elastic.py run_scaling_artifact ->
+    MULTICHIP_r07+): measured throughput per world size plus the
+    autoscale drill verdict. Like the elastic drill, the shape carries
+    the whole verdict — no trajectory walk-back: ``model_parity=false``
+    anywhere (across scaling points, or between the autoscaled run and
+    its uninterrupted baseline) fails the artifact."""
+    schema: List[str] = []
+    regressions: List[str] = []
+    notes: List[str] = []
+    if doc.get("version") != 1:
+        return ([f"multichip-scaling version {doc.get('version')!r}, "
+                 f"this checker wants 1"], [], [])
+    pts = doc.get("points")
+    if not (isinstance(pts, list) and pts):
+        schema.append("points must be a non-empty list")
+        pts = []
+    worlds: List[int] = []
+    for i, p in enumerate(pts):
+        if not isinstance(p, dict):
+            schema.append(f"points[{i}] is {type(p).__name__}, "
+                          f"not an object")
+            continue
+        w = p.get("world")
+        if not (isinstance(w, int) and not isinstance(w, bool)
+                and w >= 1):
+            schema.append(f"points[{i}].world missing/not a "
+                          f"positive int")
+        else:
+            worlds.append(w)
+        tp = p.get("throughput_rows_per_s")
+        if not _num(tp) or tp <= 0:
+            schema.append(f"points[{i}].throughput_rows_per_s "
+                          f"missing/not positive")
+        # DCN accounting: numeric where the point HAS a collective
+        # (world > 1), null where it legitimately has none (world 1,
+        # serial fallback) — but never a wrong type
+        for k in ("comm_bytes_per_iter", "psum_stall_s",
+                  "ckpt_hidden_s"):
+            v = p.get(k)
+            if v is not None and not _num(v):
+                schema.append(f"points[{i}].{k} is "
+                              f"{type(v).__name__}, not numeric/null")
+        if not isinstance(p.get("model_sha"), str):
+            schema.append(f"points[{i}].model_sha missing — parity "
+                          f"across worlds must be auditable")
+    if worlds and (worlds != sorted(worlds)
+                   or len(set(worlds)) != len(worlds)):
+        schema.append(f"points must be strictly increasing in world "
+                      f"size (got {worlds})")
+    parity = doc.get("model_parity")
+    if not isinstance(parity, bool):
+        schema.append("model_parity flag missing or non-boolean — "
+                      "the curve's verdict must be recorded")
+    elif not parity:
+        regressions.append(
+            "model_parity=false: the scaling points trained different "
+            "models — the mesh-size invariance the whole curve rests "
+            "on is broken")
+    ck = doc.get("checkpoint")
+    if ck is not None:
+        if not isinstance(ck, dict):
+            schema.append(f"checkpoint is {type(ck).__name__}, "
+                          f"not an object")
+        else:
+            h = ck.get("hidden_s")
+            if h is not None and not _num(h):
+                schema.append("checkpoint.hidden_s is "
+                              f"{type(h).__name__}, not numeric/null")
+            elif h is not None:
+                notes.append(f"checkpoint seconds hidden by the "
+                             f"background writer: {h}")
+    auto = doc.get("autoscale")
+    if not isinstance(auto, dict):
+        schema.append("autoscale section missing — the artifact must "
+                      "carry the grow-then-shrink drill verdict")
+    else:
+        ap = auto.get("model_parity")
+        if not isinstance(ap, bool):
+            schema.append("autoscale.model_parity missing or "
+                          "non-boolean")
+        elif not ap:
+            regressions.append(
+                "autoscale.model_parity=false: the grow-then-shrink "
+                "run diverged from the uninterrupted baseline — "
+                "elastic autoscale is broken")
+        rt = auto.get("reshard_total")
+        if not (isinstance(rt, int) and not isinstance(rt, bool)):
+            schema.append("autoscale.reshard_total missing/not an int")
+        elif rt < 1:
+            regressions.append(
+                "autoscale.reshard_total=0: the drill never "
+                "re-sharded — the autoscale path was not exercised")
+        aw = auto.get("worlds")
+        if not (isinstance(aw, list) and len(aw) >= 2
+                and all(isinstance(w, int) and not isinstance(w, bool)
+                        for w in aw)):
+            schema.append("autoscale.worlds must list the world-size "
+                          "sequence (>= 2 int entries)")
+        else:
+            notes.append("autoscale worlds: "
+                         + " -> ".join(str(w) for w in aw))
+    for p in pts:
+        if isinstance(p, dict) and _num(p.get("throughput_rows_per_s")):
+            notes.append(
+                f"world {p.get('world')}: "
+                f"{p['throughput_rows_per_s']:g} rows/s, "
+                f"comm {p.get('comm_bytes_per_iter')} B/iter, "
+                f"stall {p.get('psum_stall_s')} s, "
+                f"wire {p.get('wire')!r}")
+    return schema, regressions, notes
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Gate a fresh bench JSON against the BENCH_r0x "
@@ -665,6 +791,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (OSError, json.JSONDecodeError) as e:
         print(f"cannot read {args.fresh}: {e}", file=sys.stderr)
         return 2
+    if fresh.get("schema") == MULTICHIP_SCALING_SCHEMA:
+        # scaling-curve artifact (MULTICHIP_r07+): self-contained
+        # verdict, no trajectory comparison
+        schema, regressions, notes = check_multichip_scaling(fresh)
+        for p in schema:
+            print(f"SCHEMA: {p}", file=sys.stderr)
+        if schema:
+            return 2
+        for note in notes:
+            print(f"NOTE: {note}")
+        for p in regressions:
+            print(f"REGRESSION (scaling): {p}", file=sys.stderr)
+        if regressions:
+            return 1
+        worlds = [p["world"] for p in fresh["points"]]
+        print(f"pass: multichip scaling curve over worlds {worlds}, "
+              f"model parity bit-identical, autoscale reshards="
+              f"{fresh['autoscale']['reshard_total']}")
+        return 0
     if fresh.get("schema") == MULTICHIP_DRILL_SCHEMA:
         # elastic-drill artifact (MULTICHIP_r06+): self-contained
         # verdict, no trajectory comparison
@@ -715,11 +860,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"no BENCH_r*.json under {args.baseline_dir}",
               file=sys.stderr)
         return 2
-    baseline = load_bench(points[-1])
+    # shape-aware baseline selection: gate against the NEWEST
+    # eligible point whose metric string (the workload shape) matches
+    # the fresh run's. Points flagged "baseline": false are
+    # ledger-only (a quick-shape parity snapshot must not become the
+    # headline floor, nor silently absorb a full-size comparison).
+    # No same-shape eligible point = the refusal path: compare()
+    # against the newest eligible point returns "not comparable",
+    # exit 2 — a quick run is refused against a full-size trajectory
+    # instead of "passing" a meaningless comparison.
+    loaded = [(p, load_bench(p)) for p in points]
+    eligible = [(p, d) for p, d in loaded
+                if d.get("baseline") is not False]
+    if not eligible:
+        print(f"no eligible baseline (every BENCH_r*.json under "
+              f"{args.baseline_dir} is flagged \"baseline\": false)",
+              file=sys.stderr)
+        return 2
+    matching = [(p, d) for p, d in eligible
+                if d.get("metric") == fresh.get("metric")]
+    base_path, baseline = (matching or eligible)[-1]
+    baseline_name = os.path.basename(base_path)
     problems = compare(fresh, baseline, args.throughput_tol,
                        args.auc_tol, args.latency_tol,
                        args.staleness_slack)
-    baseline_name = os.path.basename(points[-1])
     # the lrb-stream fields gate against the LATEST point CARRYING
     # them comparably: when the newest point predates the stream
     # bench (or carries a different stream shape), walk back for a
@@ -728,10 +892,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # against an older carrier; cross-workload refusal above still
     # wins — a refused comparison never reaches here)
     if not problems and not _stream_comparable(fresh, baseline):
-        for p in reversed(points[:-1]):
-            cand = load_bench(p)
-            if (cand.get("metric") == fresh.get("metric")
-                    and _stream_comparable(fresh, cand)):
+        for p, cand in reversed(matching[:-1]):
+            if _stream_comparable(fresh, cand):
                 got = _compare_lrb_stream(fresh, cand,
                                           args.throughput_tol,
                                           args.staleness_slack)
@@ -743,10 +905,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # against the latest same-workload point CARRYING a comparable
     # parity block (newer points that predate it gate nothing)
     if not problems and not _parity_comparable(fresh, baseline):
-        for p in reversed(points[:-1]):
-            cand = load_bench(p)
-            if (cand.get("metric") == fresh.get("metric")
-                    and _parity_comparable(fresh, cand)):
+        for p, cand in reversed(matching[:-1]):
+            if _parity_comparable(fresh, cand):
                 got = _compare_parity(fresh, cand,
                                       args.throughput_tol)
                 if got:
@@ -759,7 +919,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
         return 1 if not problems[0].startswith("not comparable") else 2
     print(f"pass: {fresh['value']:g} {fresh['unit']} vs "
-          f"{baseline['value']:g} in {os.path.basename(points[-1])} "
+          f"{baseline['value']:g} in {baseline_name} "
           f"(tol {args.throughput_tol:.0%}), test AUC "
           f"{fresh.get('test_auc')} vs {baseline.get('test_auc')}")
     return 0
