@@ -361,6 +361,193 @@ def sparse_bench(args) -> dict:
     return out
 
 
+def make_rank_stream(path: str, n_rows: int, n_features: int,
+                     qsize: int, seed: int = 13) -> int:
+    """Write a synthetic ranking dataset straight to disk in bounded
+    blocks (label + features CSV with a .query sidecar of fixed-size
+    queries) — the WRITER never holds the full matrix, so the loader
+    under test owns the whole RSS story. Graded 0..3 relevance from a
+    per-query-shifted linear score (the learning-to-rank shape).
+    Returns the written row count (whole queries only)."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=n_features)
+    n_rows -= n_rows % qsize
+    block = max(65_536 // qsize, 1) * qsize
+    with open(path, "w") as fh:
+        for r0 in range(0, n_rows, block):
+            k = min(block, n_rows - r0)
+            X = rng.normal(size=(k, n_features))
+            qoff = rng.normal(size=k // qsize).repeat(qsize)
+            s = X @ w + qoff + rng.normal(size=k)
+            lab = np.clip(np.floor((s - s.mean())
+                          / max(float(s.std()), 1e-9) + 2.0), 0, 3)
+            np.savetxt(fh, np.column_stack([lab, X]), delimiter=",",
+                       fmt="%.6g")
+    with open(path + ".query", "w") as fh:
+        for _ in range(n_rows // qsize):
+            fh.write(f"{qsize}\n")
+    return n_rows
+
+
+def rank_route_run(args) -> dict:
+    """ONE route of the ranking bench, run in its own process so each
+    route's ru_maxrss watermark is its own (--rank-route
+    {memory,ooc}): the SAME on-disk ranking file loaded through the
+    in-memory one-round loader or the out-of-core streaming route
+    (tpu_out_of_core=1), then lambdarank trained plain AND under
+    hashed GOSS, with a same-geometry retrain to surface the
+    step-cache hit rate. The parent asserts cross-route model
+    parity (OOC is bit-identical by construction)."""
+    import hashlib
+    import resource
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.loader import DatasetLoader
+    from lightgbm_tpu.metrics import create_metrics
+    from lightgbm_tpu.models.boosting import create_boosting
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.obs import registry as obs_registry
+    from lightgbm_tpu.ops import step_cache
+
+    base = {
+        "objective": "lambdarank", "max_bin": args.max_bin,
+        "num_leaves": min(args.leaves, 63), "min_data_in_leaf": 20,
+        "learning_rate": 0.1, "tpu_stop_check_interval": 10_000,
+        "tpu_quantized_hist": not args.no_quant,
+        "tpu_ingest": 0 if args.no_ingest else -1,
+    }
+    if args.rank_route == "ooc":
+        base["tpu_out_of_core"] = 1
+    cfg = Config().set(base)
+    t0 = time.time()
+    ds = DatasetLoader(cfg).load_from_file(args.rank_file)
+    ingest_s = time.time() - t0
+
+    def fit(goss: bool):
+        obj = create_objective("lambdarank", cfg)
+        obj.init(ds.metadata, ds.num_data)
+        mets = create_metrics(["ndcg"], cfg, ds.metadata,
+                              ds.num_data)
+        g = create_boosting("goss") if goss else GBDT()
+        g.init(cfg, ds, obj, mets)
+        t1 = time.time()
+        for _ in range(args.rank_iters):
+            g.train_one_iter()
+        float(np.asarray(g._scores[0, :1])[0])     # drain the queue
+        wall = time.time() - t1
+        evals = {e[0]: round(float(e[1]), 5)
+                 for e in g.get_eval_at(0)}
+        trees = g.model_to_string().split("\nparameters:\n")[0]
+        return wall, evals, hashlib.sha1(trees.encode()).hexdigest()
+
+    train_s, ndcg, sha = fit(False)
+    goss_s, ndcg_goss, _ = fit(True)
+    # same-geometry retrains: both objective families must now ride
+    # the registry (hit rate 1.0 = the windows-2+ zero-compile story)
+    s0 = step_cache.stats()
+    fit(False)
+    fit(True)
+    s1 = step_cache.stats()
+    hits = s1["hits"] - s0["hits"]
+    misses = s1["misses"] - s0["misses"]
+    rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return {
+        "route": args.rank_route,
+        "rows": ds.num_data,
+        "queries": int(ds.metadata.num_queries),
+        "iters": args.rank_iters,
+        "ingest_s": round(ingest_s, 3),
+        "train_s": round(train_s, 3),
+        "train_goss_s": round(goss_s, 3),
+        "rows_per_s": round(
+            ds.num_data * args.rank_iters / max(train_s, 1e-9), 1),
+        "ndcg": ndcg,
+        "ndcg_goss": ndcg_goss,
+        "retrain_step_cache": {
+            "hits": hits, "misses": misses,
+            "hit_rate": round(hits / max(hits + misses, 1), 3)},
+        "ooc_blocks": obs_registry.counter("ooc/blocks").value,
+        "peak_rss_mb": round(rss_kb / 1024.0, 1),
+        "model_sha1": sha,
+    }
+
+
+def rank_bench(args) -> dict:
+    """The ranking workload bench (--rank): one on-disk lambdarank
+    dataset loaded in-memory vs out-of-core, each route in a fresh
+    subprocess so 'peak host RSS' is per-route truth (the --sparse
+    methodology). Reports NDCG (plain + hashed GOSS), rows/s, the
+    OOC peak-RSS ratio and the same-geometry retrain step-cache hit
+    rate; refuses silently-diverged models (OOC promises BIT parity)."""
+    import os
+    import subprocess
+    import tempfile
+
+    if args.quick:
+        args.rank_rows = min(args.rank_rows, 20_000)
+        args.rank_iters = min(args.rank_iters, 8)
+    routes = {}
+    with tempfile.TemporaryDirectory(prefix="rank_bench_") as td:
+        path = os.path.join(td, "rank.csv")
+        t0 = time.time()
+        n = make_rank_stream(path, args.rank_rows, args.rank_features,
+                             args.rank_qsize)
+        print(f"# rank data: {n} rows ({args.rank_qsize}-row queries) "
+              f"written in {time.time()-t0:.1f}s", file=sys.stderr)
+        for route in ("memory", "ooc"):
+            cmd = [sys.executable, __file__, "--rank-route", route,
+                   "--rank-file", path,
+                   "--rank-rows", str(n),
+                   "--rank-features", str(args.rank_features),
+                   "--rank-qsize", str(args.rank_qsize),
+                   "--rank-iters", str(args.rank_iters),
+                   "--max-bin", str(args.max_bin),
+                   "--leaves", str(args.leaves)]
+            if args.no_quant:
+                cmd.append("--no-quant")
+            if args.no_ingest:
+                cmd.append("--no-ingest")
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                print(proc.stderr[-2000:], file=sys.stderr)
+                raise RuntimeError(f"rank route {route!r} failed "
+                                   f"(exit {proc.returncode})")
+            routes[route] = json.loads(
+                proc.stdout.strip().splitlines()[-1])
+            r = routes[route]
+            print(f"# rank {route}: {r['rows_per_s']:.0f} rows/s, "
+                  f"peak RSS {r['peak_rss_mb']:.0f} MB (ingest "
+                  f"{r['ingest_s']:.2f}s, train {r['train_s']:.2f}s, "
+                  f"retrain hit rate "
+                  f"{r['retrain_step_cache']['hit_rate']:.0%})",
+                  file=sys.stderr)
+    parity = (routes["memory"]["model_sha1"]
+              == routes["ooc"]["model_sha1"])
+    if not parity:
+        print("# WARNING: rank routes trained DIFFERENT models",
+              file=sys.stderr)
+    out = {
+        "rows": n, "features": args.rank_features,
+        "qsize": args.rank_qsize, "iters": args.rank_iters,
+        "routes": {k: {kk: vv for kk, vv in v.items()
+                       if kk not in ("rows", "iters")}
+                   for k, v in routes.items()},
+        "peak_rss_ratio": round(
+            routes["memory"]["peak_rss_mb"]
+            / max(routes["ooc"]["peak_rss_mb"], 1e-9), 3),
+        "step_cache_hit_rate":
+            routes["ooc"]["retrain_step_cache"]["hit_rate"],
+        "model_parity": parity,
+    }
+    print(f"# rank bench: memory "
+          f"{routes['memory']['peak_rss_mb']:.0f} MB vs ooc "
+          f"{routes['ooc']['peak_rss_mb']:.0f} MB peak RSS "
+          f"({out['peak_rss_ratio']:.2f}x), model parity {parity}",
+          file=sys.stderr)
+    return out
+
+
 # default SLO specs per bench mode (obs/slo.py grammar): generous
 # ceilings — the section exists to put budget/burn/p99.9 numbers in
 # the artifact (gated for SHAPE by tools/check_bench_regression.py),
@@ -694,6 +881,27 @@ def main():
                     help="fraction of explicit cells in the synthetic "
                          "CTR workload (default ~1%%)")
     ap.add_argument("--sparse-iters", type=int, default=30)
+    ap.add_argument("--rank", action="store_true",
+                    help="run ONLY the ranking workload bench: one "
+                         "on-disk lambdarank dataset loaded in-memory "
+                         "vs out-of-core (tpu_out_of_core=1), each "
+                         "route in its own subprocess for a clean "
+                         "peak-RSS watermark; NDCG (plain + hashed "
+                         "GOSS), rows/s, OOC RSS ratio and the "
+                         "same-geometry retrain step-cache hit rate "
+                         "(JSON details under 'rank')")
+    ap.add_argument("--rank-route", default="",
+                    choices=["", "memory", "ooc"],
+                    help="(internal) run ONE rank-bench route in this "
+                         "process and print its JSON")
+    ap.add_argument("--rank-file", default="",
+                    help="(internal) pre-written ranking CSV for "
+                         "--rank-route")
+    ap.add_argument("--rank-rows", type=int, default=200_000)
+    ap.add_argument("--rank-features", type=int, default=16)
+    ap.add_argument("--rank-qsize", type=int, default=50,
+                    help="rows per synthetic query (default 50)")
+    ap.add_argument("--rank-iters", type=int, default=30)
     ap.add_argument("--parity", action="store_true",
                     help="append the measured reference-parity "
                          "harness to the standard bench: train BOTH "
@@ -727,6 +935,24 @@ def main():
 
     if args.sparse_route:
         print(json.dumps(sparse_route_run(args)))
+        return
+
+    if args.rank_route:
+        print(json.dumps(rank_route_run(args)))
+        return
+
+    if args.rank:
+        rank = rank_bench(args)
+        print(json.dumps({
+            "rank": rank,
+            "metric": (f"lambdarank ranking training "
+                       f"({rank['rows']} rows x "
+                       f"{rank['features']} feat, "
+                       f"{rank['qsize']}-row queries, "
+                       f"{rank['iters']} iters, out-of-core)"),
+            "value": rank["routes"]["ooc"]["rows_per_s"],
+            "unit": "rows/s",
+        }))
         return
 
     if args.sparse:

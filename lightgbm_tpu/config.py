@@ -410,6 +410,33 @@ class Config:
     # rows per ingest pipeline chunk; 0 = auto (a power of two sized so
     # one chunk carries ~64 MB of raw values).
     tpu_ingest_chunk_rows: int = 0
+    # out-of-core disk->device ingest (io/loader.py): the two-round
+    # loader's round-2 row blocks feed the streamed device binner
+    # (io/ingest.py IngestStream) directly, so the [F, N] device bin
+    # matrix assembles without ever materializing the full host value
+    # matrix — peak host RSS is bounded by the block size, not N.
+    # Bit-exact against the in-memory loader (same mappers, same
+    # value->bin kernel). -1 = auto (stream whenever the two-round
+    # loader runs and the device binner is available); 0 = off (the
+    # two-round loader materializes host bins, the pre-OOC behavior);
+    # 1 = force the two-round streaming route for file loads even when
+    # ``two_round`` is unset (parity tests, RSS-bounded ingest of
+    # bigger-than-RAM files).
+    tpu_out_of_core: int = -1
+    # rows per out-of-core round-2 block (the loader's disk-read
+    # granularity; the device binner re-chunks to its own pipeline
+    # width downstream); 0 = auto (256k rows).
+    tpu_ooc_block_rows: int = 0
+    # hashed GOSS sampling (models/boosting.py): the top-gradient +
+    # uniform-rest draw uses the shard-invariant lowbias32 hash of the
+    # GLOBAL row index and a per-tree salt (the PR-4 bagging scheme)
+    # instead of a positional PRNG, so the sampled mask is identical
+    # under any row sharding/padding AND the sampler rides the fused
+    # step as traced arrays — GOSS boosters become step-cache eligible
+    # (windows 2+ retrain at 0.00 s compile). -1 = auto (hashed);
+    # 0 = legacy positional PRNG sampler (the parity/repro oracle —
+    # per-booster jit, step-cache ineligible); 1 = hashed.
+    tpu_goss_hash: int = -1
     # process-wide compiled-step registry (ops/step_cache.py): the fused
     # training step becomes a pure function of an explicit geometry key
     # and the jitted callable is shared across boosters — a per-window
@@ -837,6 +864,18 @@ class Config:
             log.warning("tpu_ingest=%d is not one of -1/0/1; using -1 "
                         "(auto)", self.tpu_ingest)
             self.tpu_ingest = -1
+        if self.tpu_out_of_core not in (-1, 0, 1):
+            log.warning("tpu_out_of_core=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_out_of_core)
+            self.tpu_out_of_core = -1
+        if self.tpu_ooc_block_rows < 0:
+            log.warning("tpu_ooc_block_rows=%d is negative; using 0 "
+                        "(auto block size)", self.tpu_ooc_block_rows)
+            self.tpu_ooc_block_rows = 0
+        if self.tpu_goss_hash not in (-1, 0, 1):
+            log.warning("tpu_goss_hash=%d is not one of -1/0/1; "
+                        "using -1 (auto: hashed)", self.tpu_goss_hash)
+            self.tpu_goss_hash = -1
         if self.tpu_watchdog_factor < 0:
             log.warning("tpu_watchdog_factor=%g is negative; disabling "
                         "the watchdog (0)", self.tpu_watchdog_factor)

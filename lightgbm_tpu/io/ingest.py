@@ -914,6 +914,10 @@ class DeviceBinner:
     def start_stream(self) -> "IngestStream":
         return IngestStream(self)
 
+    def start_sharded_stream(self, mesh, n_global: int
+                             ) -> "ShardedIngestStream":
+        return ShardedIngestStream(self, mesh, n_global)
+
 
 # -- CSR-native sparse ingest -------------------------------------------------
 
@@ -1232,3 +1236,105 @@ class IngestStream:
                              self._b.out_dtype)
         return (self._outs[0] if len(self._outs) == 1
                 else jnp.concatenate(self._outs, axis=1))
+
+
+class ShardedIngestStream:
+    """Feed-driven variant of ``bin_matrix_sharded`` /
+    ``bin_matrix_multihost`` for the out-of-core two-round loader:
+    global rows arrive IN FILE ORDER in parser-sized blocks, and mesh
+    position gd owns the contiguous global row block [gd*S, (gd+1)*S)
+    exactly as the in-memory sharded drivers lay it out — so the stream
+    slices at shard/chunk boundaries and dispatches each completed
+    chunk pinned to the owning device while the caller parses the next
+    text block. On a multi-process mesh every rank parses the whole
+    file but TRANSFERS only the rows its addressable devices own;
+    ``finish()`` assembles the global [F, N_pad] array with the same
+    cross-process assembly as ``bin_matrix_multihost``. Bit-exact vs
+    the in-memory drivers: identical compiled chunk kernel, identical
+    row->device map (chunk k of shard gd covers global rows
+    [gd*S + k*C, min(gd*S + (k+1)*C, (gd+1)*S, n)))."""
+
+    def __init__(self, binner: DeviceBinner, mesh, n_global: int):
+        import jax
+        self._b = binner
+        self._mesh = mesh
+        self._n = int(n_global)
+        self._positions = list(mesh.devices.reshape(-1))
+        self._S = shard_width(self._n, len(self._positions),
+                              binner.hist_chunk)
+        proc = jax.process_index()
+        self._local = {gd: dev
+                       for gd, dev in enumerate(self._positions)
+                       if dev.process_index == proc}
+        self._multiproc = any(d.process_index != proc
+                              for d in self._positions)
+        self._cursor = 0                # global row index of _pend[0]
+        self._pend: List[np.ndarray] = []
+        self._pend_rows = 0
+        self._outs = {gd: [] for gd in self._local}
+        self._rows_local = 0
+
+    def _boundary(self):
+        """(owning shard, next dispatch boundary) for the cursor: the
+        end of the current chunk, clipped to the shard end and n."""
+        S, C, n = self._S, self._b.chunk_rows, self._n
+        gd = self._cursor // S
+        off = self._cursor - gd * S
+        return gd, min(gd * S + (off // C + 1) * C, (gd + 1) * S, n)
+
+    def feed(self, X: np.ndarray) -> None:
+        self._pend.append(np.asarray(X))
+        self._pend_rows += X.shape[0]
+        while self._pend_rows and self._cursor < self._n:
+            gd, bnd = self._boundary()
+            need = bnd - self._cursor
+            if self._pend_rows < need:
+                break
+            self._emit(gd, need)
+
+    def _emit(self, gd: int, rows: int) -> None:
+        block = (self._pend[0] if len(self._pend) == 1
+                 else np.concatenate(self._pend, axis=0))
+        take, rest = block[:rows], block[rows:]
+        self._pend = [rest] if rest.shape[0] else []
+        self._pend_rows = int(rest.shape[0])
+        self._cursor += rows
+        if gd in self._local:
+            self._outs[gd].append(self._b._submit(
+                self._b._prep_chunk(take), device=self._local[gd]))
+            self._rows_local += rows
+
+    def finish(self):
+        """-> row-sharded [F, N_pad] device bins over every fed row
+        (trailing ``N_pad - n`` columns are zero-bin padding)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.learners import AXIS
+        while self._pend_rows and self._cursor < self._n:
+            gd, bnd = self._boundary()
+            self._emit(gd, min(bnd - self._cursor,
+                                   self._pend_rows))
+        n, S = self._n, self._S
+        D = len(self._positions)
+        F = len(self._b.mappers)
+        shards = []
+        for gd, dev in self._local.items():
+            rows_d = max(min(S, n - gd * S), 0)
+            parts = self._outs[gd]
+            if rows_d < S:
+                # zero-bin tail (row padding): committed to device gd
+                # so the assembled shard never leaves it
+                parts.append(jax.device_put(
+                    jnp.zeros((F, S - rows_d), self._b.out_dtype),
+                    dev))
+            shards.append(parts[0] if len(parts) == 1
+                          else jnp.concatenate(parts, axis=1))
+        if self._multiproc:
+            from ..parallel import cluster
+            obs.counter("ingest/rows_local_host").add(self._rows_local)
+            return cluster.local_shards_to_global(
+                shards, (F, D * S), self._mesh, None, AXIS)
+        sharding = NamedSharding(self._mesh, P(None, AXIS))
+        return jax.make_array_from_single_device_arrays(
+            (F, D * S), sharding, shards)

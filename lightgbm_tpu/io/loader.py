@@ -109,7 +109,7 @@ class DatasetLoader:
             log.info("Loading dataset from binary cache %s", bin_cache)
             return TpuDataset.load_binary(bin_cache, cfg)
 
-        if cfg.two_round:
+        if cfg.two_round or cfg.tpu_out_of_core == 1:
             ds = self._load_two_round(filename, reference)
         else:
             X, meta, names, categorical = self._parse_with_metadata(
@@ -142,15 +142,21 @@ class DatasetLoader:
 
     def _load_two_round(self, filename: str,
                         reference: Optional[TpuDataset] = None,
-                        chunk_rows: int = 1 << 18) -> TpuDataset:
-        """two_round=true: the reference's memory-light path
-        (dataset_loader.cpp LoadFromFile with two_round —
-        SampleTextDataFromFile then a second streaming pass,
-        :196-235/:657-704). Pass 1 counts rows and parses only a sampled
-        subset to build the bin mappers; pass 2 re-streams the file in
-        ``chunk_rows`` blocks, binning each block straight into the
-        uint8 matrix — the full float matrix never exists."""
+                        chunk_rows: int = 0) -> TpuDataset:
+        """two_round=true (or tpu_out_of_core=1): the reference's
+        memory-light path (dataset_loader.cpp LoadFromFile with
+        two_round — SampleTextDataFromFile then a second streaming
+        pass, :196-235/:657-704). Pass 1 counts rows and parses only a
+        sampled subset to build the bin mappers; pass 2 re-streams the
+        file in ``chunk_rows`` blocks (tpu_ooc_block_rows; 0 = 256k),
+        binning each block straight into the uint8 matrix — the full
+        float matrix never exists. With device ingest on, each block
+        feeds the double-buffered device binner and even the host bin
+        matrix disappears: peak RSS is bounded by the block size, not
+        N (tpu_out_of_core=0 pins the host-bins fallback)."""
         cfg = self.config
+        if chunk_rows <= 0:
+            chunk_rows = int(cfg.tpu_ooc_block_rows) or (1 << 18)
         from .dataset import find_column_mappers
         from .parser import (_first_data_lines, detect_format,
                              parse_delimited, parse_libsvm)
@@ -247,16 +253,37 @@ class DatasetLoader:
         f_used = max(len(ds.mappers), 1)
         dtype = np.uint8 if ds.max_bin_global <= 256 else np.int32
         from .ingest import (DeviceBinner, IngestUnsupported,
-                             ingest_enabled)
+                             ingest_enabled, ingest_mesh)
         stream = None
-        if (ingest_enabled(cfg) and ds.mappers
+        efb_live = (reference is None and cfg.enable_bundle
+                    and ds.num_features > 1)
+        if (cfg.tpu_out_of_core != 0 and ingest_enabled(cfg)
+                and ds.mappers
                 and (reference is None or reference.bundles is None)):
             try:
-                stream = DeviceBinner(ds.mappers, ds.used_feature_map,
-                                      cfg, np.float64).start_stream()
+                binner = DeviceBinner(ds.mappers, ds.used_feature_map,
+                                      cfg, np.float64)
             except IngestUnsupported as e:
                 log.debug("two_round device ingest unavailable (%s); "
                           "host binner", e)
+            else:
+                # valid sets ride as passenger columns of the grower
+                # matrix (models/gbdt.py) — only the train set's rows
+                # are worth sharding at ingest time
+                mesh = ingest_mesh(cfg) if reference is None else None
+                import jax
+                if (mesh is not None and efb_live
+                        and jax.process_count() > 1):
+                    # an engaged EFB probe would need the global array
+                    # materialized on one host, which a multi-process
+                    # mesh cannot provide — host binner keeps the
+                    # bundling decision bit-identical
+                    log.debug("two_round: EFB probe + multi-process "
+                              "mesh; host binner")
+                elif mesh is not None:
+                    stream = binner.start_sharded_stream(mesh, n)
+                else:
+                    stream = binner.start_stream()
         bins = (None if stream is not None
                 else np.zeros((n, f_used), dtype))
         # EFB probe sample: the same rng(3) rows find_bundles would
@@ -266,8 +293,7 @@ class DatasetLoader:
         # analog)
         efb_sorted = None
         efb_rows: List[np.ndarray] = []
-        if (stream is not None and reference is None
-                and cfg.enable_bundle and ds.num_features > 1):
+        if stream is not None and efb_live:
             from .efb import sample_rows_for_probe
             idx = sample_rows_for_probe(n)
             efb_sorted = np.arange(n) if idx is None else np.sort(idx)
@@ -281,6 +307,9 @@ class DatasetLoader:
             nonlocal row
             if not buf:
                 return
+            obs.counter("ooc/blocks").add(1)
+            obs.counter("ooc/disk_bytes").add(
+                sum(len(s) + 1 for s in buf))
             p = parse_lines(buf, ncol)
             Xc = p.values
             if Xc.shape[1] < ncol:
@@ -348,17 +377,28 @@ class DatasetLoader:
                 # the host path would have built
                 log.info("two_round: EFB bundles this data; "
                          "materializing device bins on host")
-                ds.bins = np.ascontiguousarray(np.asarray(dev).T)
+                ds.bins = np.ascontiguousarray(
+                    np.asarray(dev)[:, :n].T).astype(dtype, copy=False)
             else:
                 ds.bins_t_dev = dev
+                ds.bins_t_dev_pad = dev.shape[1] - n
                 log.info("two_round: streamed device ingest "
-                         "(%d rows)", n)
+                         "(%d rows%s)", n,
+                         f", {ds.bins_t_dev_pad} pad"
+                         if ds.bins_t_dev_pad else "")
         ds.metadata = self._assemble_metadata(
             filename, label if sparsed.label is not None else None,
             weight, group_col)
         ds.metadata.check_or_partition(n)
         if ds.bins is not None:
             ds._apply_efb()  # handles both fresh and reference bundles
+        try:
+            import resource
+            obs.gauge("ooc/rss_peak_mb").set(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                / 1024.0)
+        except ImportError:        # non-POSIX host
+            pass
         log.info("two_round load: %d rows binned in %d-row blocks",
                  n, chunk_rows)
         return ds

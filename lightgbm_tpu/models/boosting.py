@@ -13,6 +13,15 @@ Design notes vs the reference:
   (goss.hpp:89-133) — a deliberate TPU-native substitution: the exact
   sampler is a sequential scan over rows, the Bernoulli draw is one
   fused elementwise pass.
+- The DEFAULT Bernoulli stream (``tpu_goss_hash != 0``) is the
+  shard-invariant lowbias32 hash of (global row index, per-tree salt)
+  — the PR-4 bagging scheme: each row's draw depends only on its
+  global index, never on the padded width or the mesh layout, and the
+  real row count rides the traced ``rvalid`` mask. That makes hashed
+  GOSS step-cache ELIGIBLE (ops/step_cache.py): sliding-window GOSS
+  retrains hit the process-wide registry at 0 compile. The legacy
+  positional-PRNG sampler (``tpu_goss_hash=0``) is kept verbatim as
+  the parity/repro oracle and stays per-booster-jitted.
 - DART keeps the reference's host-driven drop bookkeeping (tree weights,
   skip/max/uniform drop, normalization algebra dart.hpp:86-190) but all
   score adjustments replay device TreeRecords — no host transfer.
@@ -39,17 +48,37 @@ def create_boosting(boosting_type: str) -> GBDT:
         boosting_type]()
 
 
+# stream-separation salt for the hashed GOSS draw: the step's PRNG
+# seed also salts the grower's stochastic-rounding streams (salt and
+# salt ^ 0x9E3779B9, ops/wave_grower.py), so GOSS xors a third
+# constant to keep its uniform draws independent of the rounding
+_GOSS_SALT = 0x27D4EB2F
+
+
 class GOSS(GBDT):
     """Gradient-based One-Side Sampling (goss.hpp:26-216)."""
 
-    # no shared-step reuse: the in-jit sampler draws a positional PRNG
-    # stream (jax.random.uniform over the row axis) whose values depend
-    # on the padded width — bucket-padded GOSS would not be bit-exact
+    # class default covers the legacy positional-PRNG oracle
+    # (tpu_goss_hash=0): its jax.random.uniform stream depends on the
+    # padded width, so bucket-padded it would not be bit-exact. The
+    # hashed sampler flips the gate per-instance in init().
     _step_cache_ok = False
 
     def init(self, config, train_data, objective, training_metrics=()):
+        # must precede super().init(): eligibility is snapshotted
+        # during grower setup
+        self._step_cache_ok = config.tpu_goss_hash != 0
         super().init(config, train_data, objective, training_metrics)
         self._reset_goss()
+
+    def _sample_static_key(self):
+        """Everything the hashed hook closes over (geometry-key
+        component): the sampling rates. The legacy oracle never
+        reaches the registry, so its closure ints don't ride here."""
+        if self.config.tpu_goss_hash == 0:
+            return ("goss_legacy",)
+        return ("goss_hash", float(self.config.top_rate),
+                float(self.config.other_rate))
 
     def _reset_goss(self):
         cfg = self.config
@@ -59,21 +88,87 @@ class GOSS(GBDT):
             log.fatal("top_rate and other_rate should be larger than 0")
         if cfg.bagging_freq > 0 and cfg.bagging_fraction != 1.0:
             log.fatal("Cannot use bagging in GOSS")
-        log.info("Using GOSS")
+        log.info("Using GOSS%s",
+                 "" if cfg.tpu_goss_hash != 0 else " (legacy sampler)")
         self._hook_rng = np.random.default_rng(cfg.bagging_seed)
-        n = self._n
-        top_k = max(1, int(n * cfg.top_rate))
-        other_k = max(1, int(n * cfg.other_rate))
-        multiply = (n - top_k) / other_k
         # GOSS starts after 1/learning_rate warmup iterations
         # (goss.hpp:137-139); traced as a flag so the step doesn't
         # retrace when it switches on
         self._goss_warmup = int(1.0 / max(cfg.learning_rate, 1e-12))
+        self._sample_hook = (self._hash_hook() if cfg.tpu_goss_hash != 0
+                             else self._legacy_hook())
+        self._step_key = None
 
-        def hook(g_all, h_all, mask, key):
+    def _hash_hook(self):
+        """The shard-invariant sampler: top-gradient threshold from an
+        exact device sort over VALID rows, uniform-rest draw from the
+        lowbias32 hash of (global row index, per-tree salt). Closes
+        only over the two rates (covered by _sample_static_key), so
+        the hook rides the process-wide shared step; the real row
+        count, threshold index and amplification factor are all TRACED
+        from ``rvalid`` — boosters with different N share one compiled
+        step."""
+        from ..ops.wave_grower import _hash_uniform
+        top_rate = float(self.config.top_rate)
+        other_rate = float(self.config.other_rate)
+
+        def hook(g_all, h_all, mask, key, rvalid):
             # PRNGKey stores the seed in word 1 (word 0 is the high
             # half, zero for any sub-2^32 seed); the warmup dummy is
             # PRNGKey(0) and real seeds are drawn from [1, 2^31)
+            on = key[1] != jnp.uint32(0)
+            score = jnp.sum(jnp.abs(g_all * h_all), axis=0)  # [width]
+            width = score.shape[0]
+            if rvalid is None:
+                # legacy routing (tpu_step_cache=0): exact row shapes,
+                # every row real
+                nf = jnp.float32(width)
+                score_v = score
+            else:
+                nf = jnp.sum(rvalid.astype(jnp.float32))
+                # pad rows sort to the bottom and never enter the top
+                # set (real scores are >= 0)
+                score_v = jnp.where(rvalid, score, -1.0)
+            top_k = jnp.maximum(jnp.floor(nf * jnp.float32(top_rate)),
+                                1.0)
+            other_k = jnp.maximum(
+                jnp.floor(nf * jnp.float32(other_rate)), 1.0)
+            multiply = (nf - top_k) / other_k
+            sorted_desc = -jnp.sort(-score_v)
+            thr = jnp.take(sorted_desc, top_k.astype(jnp.int32) - 1)
+            is_top = score_v >= thr
+            p = other_k / jnp.maximum(nf - top_k, 1.0)
+            u = _hash_uniform(jnp.arange(width, dtype=jnp.uint32),
+                              key[1] ^ jnp.uint32(_GOSS_SALT))
+            sampled = (u < p) & ~is_top
+            if rvalid is not None:
+                sampled = sampled & rvalid
+            amp = jnp.where(sampled, multiply, 1.0)
+            keep = (is_top | sampled).astype(jnp.float32)
+            keep = jnp.where(on, keep, 1.0)
+            amp = jnp.where(on, amp, 1.0)
+            # tail = alignment pad + any valid-set passenger rows; its
+            # mask is already zero, keep it that way
+            tail = mask.shape[0] - width
+            if tail:
+                keep = jnp.concatenate(
+                    [keep, jnp.zeros(tail, jnp.float32)])
+            return g_all * amp, h_all * amp, mask * keep
+        return hook
+
+    def _legacy_hook(self):
+        """The pre-hash positional-PRNG sampler, kept VERBATIM as the
+        parity/repro oracle (tpu_goss_hash=0): its uniform stream is
+        positional (padded-width dependent) and its count scalars are
+        closure ints, so it stays per-booster-jitted and step-cache
+        ineligible."""
+        cfg = self.config
+        n = self._n
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        multiply = (n - top_k) / other_k
+
+        def hook(g_all, h_all, mask, key, rvalid=None):
             on = key[1] != jnp.uint32(0)
             score = jnp.sum(jnp.abs(g_all * h_all), axis=0)   # [N]
             thr = jax.lax.top_k(score, top_k)[0][-1]
@@ -94,8 +189,7 @@ class GOSS(GBDT):
             g_all = g_all * amp
             h_all = h_all * amp
             return g_all, h_all, mask * keep
-        self._sample_hook = hook
-        self._step_key = None
+        return hook
 
     def train_one_iter(self, grad=None, hess=None):
         # during warmup, signal the hook off through a zeroed key

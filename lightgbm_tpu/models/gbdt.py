@@ -56,10 +56,12 @@ K_MODEL_VERSION = "v2"     # gbdt.h kModelVersion
 class GBDT:
     """Gradient Boosting Decision Tree driver (boosting.h:22 interface)."""
 
-    # class-level gate for the compiled-step registry: variants whose
-    # step is not a pure function of the shared geometry opt out —
-    # GOSS's in-jit sampler draws a positional PRNG stream whose values
-    # depend on the padded width, RF replaces the step entirely
+    # gate for the compiled-step registry: variants whose step is not
+    # a pure function of the shared geometry opt out — RF replaces the
+    # step entirely; GOSS flips this per-INSTANCE (models/boosting.py):
+    # the hashed sampler (tpu_goss_hash != 0) is pad/shard-invariant
+    # and rides the shared step, the legacy positional-PRNG oracle
+    # (tpu_goss_hash=0) keeps the per-booster closure
     _step_cache_ok = True
 
     def __init__(self):
@@ -502,6 +504,17 @@ class GBDT:
             # consume a bucketed width (nor the shared step an exact
             # one) — a flipped knob only affects future boosters
             self._cache_eligible = prev_elig
+        from ..obs import registry as obs
+        # eligibility split by objective family: which production
+        # workloads actually ride the registry vs fall back to the
+        # per-booster closure (run reports + Prometheus export)
+        family = (self.objective.name if self.objective is not None
+                  else "none")
+        verdict = "eligible" if self._cache_eligible else "ineligible"
+        # bounded-cardinality: family is an in-tree objective class
+        # name ("none" for custom-gradient boosters), verdict one of
+        # eligible/ineligible
+        obs.counter(f"step_cache/{verdict}/{family}").add(1)
         B_hist = max(self.train_data.max_bin_global, 2)
         if self._cache_eligible:
             B_hist = step_cache.bucket_bins(B_hist, cfg.tpu_row_bucket)
@@ -752,7 +765,7 @@ class GBDT:
         a boosting variant whose step is the standard one. Reads THIS
         booster's config knob, not the module global — another
         booster's init must not flip a live booster's shape policy."""
-        if self.config.tpu_step_cache == 0 or not type(self)._step_cache_ok:
+        if self.config.tpu_step_cache == 0 or not self._step_cache_ok:
             return False
         if self._use_bundles or mode not in ("serial", "data"):
             return False
@@ -1250,16 +1263,34 @@ class GBDT:
         """Host aux pytree -> device: every array leaf's LAST axis is
         the row axis (objectives/objective.py seam contract); pad it
         from n to the bucketed n_score with zeros and place it under
-        the step's row sharding."""
+        the step's row sharding. Leaves under a dict key starting with
+        ``_`` are NOT row-shaped (lambdarank's padded query tables):
+        they are placed replicated, unpadded."""
         if aux is None:
             return None
         if isinstance(aux, dict):
-            return {k: self._pad_step_aux(v) for k, v in aux.items()}
+            return {k: (self._place_step_raw(v) if k.startswith("_")
+                        else self._pad_step_aux(v))
+                    for k, v in aux.items()}
         a = np.asarray(aux)
         pad = self._n_score - a.shape[-1]
         if pad:
             a = np.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
         return self._place_step_rows(a)
+
+    def _place_step_raw(self, x):
+        """Non-row-shaped shared-step aux leaf (the ``_``-prefixed seam
+        keys): replicated over the mesh when one is live, so the jitted
+        step never reshards it."""
+        if x is None:
+            return None
+        x = np.asarray(x)
+        if self._mesh is None:
+            return jnp.asarray(x)
+        spec = (None,) * x.ndim
+        if self._multiprocess_mesh():
+            return self._global_put(x, *spec)
+        return jax.device_put(x, self._named_sharding(*spec))
 
     def _step_geometry_key(self, custom: bool, obj, renew_alpha,
                            aux_dev, meta_dev) -> tuple:
@@ -1281,6 +1312,9 @@ class GBDT:
             (bins.shape[0], str(bins.dtype)),
             ("custom",) if custom or obj is None else obj.static_key(),
             renew_alpha,
+            # in-jit sample hook statics (hashed GOSS closes over its
+            # rates; GBDT contributes a no-sample marker)
+            self._sample_static_key(),
             step_cache.aux_signature(aux_dev),
             step_cache.aux_signature(
                 dict(zip(type(meta_dev)._fields, meta_dev))),
@@ -1289,6 +1323,13 @@ class GBDT:
             ("sparse", None if getattr(self, "_sparse_dev", None) is None
              else int(self._sparse_dev[0].shape[0])),
         )
+
+    def _sample_static_key(self) -> tuple:
+        """Hashable statics of the in-jit sample hook — the geometry-
+        key component covering everything a REGISTRY-ELIGIBLE hook
+        closes over. GBDT has no hook; hashed GOSS overrides this with
+        its sampling rates (models/boosting.py)."""
+        return ("nosample",)
 
     @staticmethod
     def _renew_aux(obj):
@@ -1331,14 +1372,19 @@ class GBDT:
         grower = self._grower
         K = self.num_tree_per_iteration
 
+        sample_hook = self._sample_hook
+
         def builder():
+            # the hook is registry-shareable: an eligible hook closes
+            # only over config scalars, all covered by the geometry
+            # key's _sample_static_key() component
             return step_cache.build_train_step(
                 grower=grower, K=K, n_score=self._n_score,
                 n_total=self._n_total,
                 valid_slices=tuple(self._valid_row_slices),
                 num_leaves=self._grower_cfg.num_leaves,
                 grad_fn=grad_fn, renew_alpha=renew_alpha,
-                sample_hook=None)
+                sample_hook=sample_hook)
 
         shared = step_cache.get_step(key, builder)
         rvalid = self._rvalid_dev

@@ -65,9 +65,12 @@ class ObjectiveFunction:
     #                          closes over (part of the geometry key)
     # ``get_gradients`` delegates to the same pure fn, so the legacy
     # per-instance step and the shared step run IDENTICAL code — a
-    # registry hit cannot change numerics. Objectives without a sound
-    # pure seam (lambdarank's query-padded aux) return None and keep
-    # the legacy closure.
+    # registry hit cannot change numerics. Aux dict keys starting with
+    # ``_`` are NOT row-shaped (lambdarank's padded query tables) and
+    # ride to the device unpadded/replicated. An objective without a
+    # sound pure seam would return None and keep the legacy closure
+    # (none remain in-tree — lambdarank, the last holdout, rides its
+    # query tables as ``_``-keys).
 
     def gradient_aux(self):
         return None
@@ -707,17 +710,69 @@ class LambdarankNDCG(ObjectiveFunction):
                          / np.log2(np.arange(len(top)) + 2.0))
             self.inv_max_dcg[q] = 1.0 / dcg if dcg > 0 else 0.0
 
+    def _bucketed_query_tables(self):
+        """(q_idx, q_valid, inv_max_dcg) with the QUERY axis padded to
+        its pow2 bucket under the booster's ``tpu_row_bucket`` policy
+        (0 = exact), so ranking windows whose query counts land in the
+        same bucket share ONE compiled step — the sliding-window
+        retrain hits the registry instead of re-tracing per window.
+        Pad queries are all-invalid: every pairwise term is masked by
+        ``pair_ok`` and the scatter by ``flat_valid``, so they
+        contribute exact +0.0 (bit-identical to the exact-shape run).
+        ``qmax`` is deliberately NOT bucketed: the per-query pair sums
+        reduce over that axis, and a wider axis regroups the reduction
+        of the REAL values (ulp drift) even though the pad terms are
+        exact zeros."""
+        from ..ops.step_cache import pow2_bucket
+        nq, qmax = self.q_idx.shape
+        if getattr(self.config, "tpu_row_bucket", -1) == 0:
+            return self.q_idx, self.q_valid, self.inv_max_dcg
+        nq_p = pow2_bucket(nq, 16)
+        if nq_p == nq:
+            return self.q_idx, self.q_valid, self.inv_max_dcg
+        idx = np.zeros((nq_p, qmax), np.int32)
+        valid = np.zeros((nq_p, qmax), bool)
+        imd = np.zeros(nq_p, np.float64)
+        idx[:nq] = self.q_idx
+        valid[:nq] = self.q_valid
+        imd[:nq] = self.inv_max_dcg
+        return idx, valid, imd
+
+    def gradient_aux(self):
+        idx, valid, imd = self._bucketed_query_tables()
+        return {
+            "y": self.label.astype(np.int32),
+            "w": self.weights,
+            # query tables are [nq, qmax]/[nq] — NOT row-shaped; the
+            # ``_`` prefix tells the caller to place them unpadded
+            "_q_idx": idx,
+            "_q_valid": valid,
+            "_inv_max_dcg": imd.astype(np.float32),
+            "_label_gain": self.label_gain.astype(np.float32),
+        }
+
+    def gradient_builder(self):
+        sigmoid = self.sigmoid
+        weighted = self.weights is not None
+
+        def fn(score, aux):
+            lam, hes = _lambdarank_grads(
+                score, jnp.asarray(aux["y"]),
+                jnp.asarray(aux["_q_idx"]),
+                jnp.asarray(aux["_q_valid"]),
+                jnp.asarray(aux["_inv_max_dcg"]),
+                jnp.asarray(aux["_label_gain"]), sigmoid)
+            if weighted:
+                w = jnp.asarray(aux["w"])
+                lam, hes = lam * w, hes * w
+            return lam, hes
+        return fn
+
+    def static_key(self):
+        return ("lambdarank", float(self.sigmoid))
+
     def get_gradients(self, score):
-        lambdas, hess = _lambdarank_grads(
-            score, jnp.asarray(self.label.astype(np.int32)),
-            jnp.asarray(self.q_idx), jnp.asarray(self.q_valid),
-            jnp.asarray(self.inv_max_dcg.astype(np.float32)),
-            jnp.asarray(self.label_gain.astype(np.float32)),
-            self.sigmoid)
-        if self.weights is not None:
-            w = jnp.asarray(self.weights)
-            lambdas, hess = lambdas * w, hess * w
-        return lambdas, hess
+        return self.gradient_builder()(score, self.gradient_aux())
 
     def to_string(self):
         return "lambdarank"

@@ -36,13 +36,18 @@ What had to move out of the per-instance closures to get there:
 The registry key covers everything that shapes the trace (learner
 mode, mesh device ids, WaveGrowerConfig incl. split hyperparameters
 and forced splits, valid-set slice layout, bins dtype/shape, objective
-static key, aux structure, renew spec), so a hit is guaranteed to be a
-functionally identical program. Ineligible configurations (EFB
-bundles, feature/voting learners, RF's averaging step, GOSS — its
-in-jit sampler draws a positional PRNG stream whose values depend on
-the padded width, so bucket-padded GOSS would not be bit-exact —
-and objectives without a pure gradient seam) simply keep the legacy
-per-instance closure — correctness first, reuse where it is sound.
+static key, aux structure, renew spec, sample-hook statics), so a hit
+is guaranteed to be a functionally identical program. Ineligible
+configurations (EFB bundles, feature/voting learners, RF's averaging
+step, legacy-PRNG GOSS under ``tpu_goss_hash=0`` — its in-jit sampler
+draws a positional PRNG stream whose values depend on the padded
+width, so bucket-padded it would not be bit-exact) simply keep the
+legacy per-instance closure — correctness first, reuse where it is
+sound. Hashed GOSS (the default) samples on the shard-invariant
+lowbias32 hash of the global row index and rides the shared step as a
+traced mask; lambdarank rides its query tables as ``_``-keyed aux
+arrays — both production modes hit the registry on same-geometry
+retrains.
 
 Counters land in the obs registry (``step_cache/hits|misses|
 evictions``, ``step_cache/compile`` timer with per-key first-dispatch
@@ -291,8 +296,9 @@ def build_train_step(*, grower, K: int, n_score: int, n_total: int,
       ``rvalid``) is a traced argument, so the compiled program is
       shared by every booster with the same geometry key;
     - **legacy per-booster closure** (GBDT._get_step_fn for
-      cache-ineligible configurations — GOSS's positional sampler,
-      EFB bundles, feature/voting learners, tpu_step_cache=0): the
+      cache-ineligible configurations — GOSS's legacy positional
+      sampler (tpu_goss_hash=0), EFB bundles, feature/voting
+      learners, tpu_step_cache=0): the
       caller passes ``rvalid=None`` (exact row shapes, no validity
       mask) and ``meta=None`` (the grower consumes its own closure
       metadata), and the jitted step stays per-instance.
@@ -329,8 +335,13 @@ def build_train_step(*, grower, K: int, n_score: int, n_total: int,
             h_all = jnp.where(rvalid[None, :], h_all, 0.0)
         if sample_hook is not None:
             # in-jit gradient-based sampling (GOSS): may amplify g/h
-            # and shrink the bagging mask, all device-side
-            g_all, h_all, mask = sample_hook(g_all, h_all, mask, key)
+            # and shrink the bagging mask, all device-side. The hook
+            # receives rvalid (None on the legacy route) so the hashed
+            # sampler derives the REAL row count from the traced
+            # validity mask instead of a closure int — the registry
+            # path stays pure in its geometry.
+            g_all, h_all, mask = sample_hook(g_all, h_all, mask, key,
+                                             rvalid)
         recs = []
         vs = list(valid_scores)
         for k in range(K):

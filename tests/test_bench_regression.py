@@ -264,6 +264,123 @@ def test_check_schema_sparse():
         "not comparable")
 
 
+def _rank_block(**kw):
+    d = {"rows": 200_000, "features": 16, "qsize": 50, "iters": 30,
+         "routes": {
+             "memory": {"route": "memory", "queries": 4000,
+                        "ingest_s": 5.1, "train_s": 62.0,
+                        "rows_per_s": 96774.0, "peak_rss_mb": 1810.0,
+                        "ndcg": {"ndcg@1": 0.91, "ndcg@5": 0.87},
+                        "ndcg_goss": {"ndcg@1": 0.90, "ndcg@5": 0.86},
+                        "retrain_step_cache": {"hits": 2, "misses": 0,
+                                               "hit_rate": 1.0},
+                        "model_sha1": "bb"},
+             "ooc": {"route": "ooc", "queries": 4000, "ingest_s": 6.3,
+                     "train_s": 63.0, "rows_per_s": 95238.0,
+                     "peak_rss_mb": 705.0,
+                     "ndcg": {"ndcg@1": 0.91, "ndcg@5": 0.87},
+                     "ndcg_goss": {"ndcg@1": 0.90, "ndcg@5": 0.86},
+                     "retrain_step_cache": {"hits": 2, "misses": 0,
+                                            "hit_rate": 1.0},
+                     "model_sha1": "bb"}},
+         "peak_rss_ratio": 2.567, "step_cache_hit_rate": 1.0,
+         "model_parity": True}
+    d.update(kw)
+    return d
+
+
+def test_check_schema_rank():
+    # the standalone --rank line: unit rows/s + rank block (the
+    # section key disambiguates it from --sparse, which shares the
+    # unit)
+    standalone = {"metric": "lambdarank ranking training (200000 rows "
+                            "x 16 feat, 50-row queries, 30 iters, "
+                            "out-of-core)",
+                  "value": 95238.0, "unit": "rows/s",
+                  "rank": _rank_block()}
+    assert cbr.check_schema(standalone) == []
+    # missing route metrics are named per route
+    broken = _rank_block()
+    del broken["routes"]["ooc"]["peak_rss_mb"]
+    assert any("rank.routes.ooc.peak_rss_mb" in p
+               for p in cbr.check_schema(dict(standalone, rank=broken)))
+    no_mem = _rank_block()
+    del no_mem["routes"]["memory"]
+    assert any("rank.routes.memory" in p for p in cbr.check_schema(
+        dict(standalone, rank=no_mem)))
+    # NDCG must survive as a non-empty numeric dict — the quality
+    # ledger must not silently disappear
+    no_ndcg = _rank_block()
+    no_ndcg["routes"]["ooc"]["ndcg"] = {}
+    assert any("rank.routes.ooc.ndcg" in p for p in cbr.check_schema(
+        dict(standalone, rank=no_ndcg)))
+    # the step-cache hit rate and RSS ratio are the PR's headline
+    # observables — a line that lost them fails shape
+    for k in ("peak_rss_ratio", "step_cache_hit_rate"):
+        gone = _rank_block()
+        del gone[k]
+        assert any(f"rank.{k}" in p for p in cbr.check_schema(
+            dict(standalone, rank=gone)))
+    # OOC promises BIT parity: diverged models fail the artifact
+    assert any("model_parity" in p for p in cbr.check_schema(
+        dict(standalone, rank=_rank_block(model_parity=False))))
+    # wrong container types are reported, not crashed on
+    assert any("not a dict" in p for p in cbr.check_schema(
+        dict(standalone, rank="n/a")))
+    assert any("rank.routes" in p for p in cbr.check_schema(
+        dict(standalone, rank=_rank_block(routes=7))))
+    # cross-workload refusal still wins — a rank line never compares
+    # against a sparse line even though they share the rows/s unit
+    sparse_line = {"metric": "sparse CTR GBDT training (...)",
+                   "value": 151898.0, "unit": "rows/s",
+                   "sparse": _sparse_block()}
+    assert cbr.compare(standalone, sparse_line)[0].startswith(
+        "not comparable")
+
+
+def test_compare_rank_gate():
+    metric = ("lambdarank ranking training (200000 rows x 16 feat, "
+              "50-row queries, 30 iters, out-of-core)")
+
+    def line(**kw):
+        return {"metric": metric, "value": 95238.0, "unit": "rows/s",
+                "rank": _rank_block(**kw)}
+
+    def with_ooc(**route_kw):
+        blk = _rank_block()
+        blk["routes"]["ooc"].update(route_kw)
+        return {"metric": metric, "value": 95238.0, "unit": "rows/s",
+                "rank": blk}
+
+    base = line()
+    # same numbers: pass
+    assert cbr.compare(line(), base) == []
+    # NDCG floor (--auc-tol): ranking quality must not silently decay
+    probs = cbr.compare(
+        with_ooc(ndcg={"ndcg@1": 0.80, "ndcg@5": 0.87}), base)
+    assert probs and "ranking-quality regression" in probs[0]
+    assert "ndcg@1" in probs[0]
+    # within the tolerance: pass
+    assert cbr.compare(
+        with_ooc(ndcg={"ndcg@1": 0.9095, "ndcg@5": 0.87}), base) == []
+    # OOC peak-RSS ceiling (--latency-tol slack): RSS creep back
+    # toward the in-memory watermark is the regression OOC prevents
+    probs = cbr.compare(with_ooc(peak_rss_mb=1500.0), base)
+    assert probs and "out-of-core RSS regression" in probs[0]
+    assert cbr.compare(with_ooc(peak_rss_mb=900.0), base) == []
+    # a fresh run that LOST the section against a carrier is a problem
+    lost = {"metric": metric, "value": 95238.0, "unit": "rows/s"}
+    probs = cbr.compare(lost, base)
+    assert probs and "no rank section" in probs[0]
+    # a baseline without the section gates nothing
+    assert cbr.compare(line(), lost) == []
+    # headline rows/s still rides the generic value floor
+    slow = line()
+    slow["value"] = 10_000.0
+    probs = cbr.compare(slow, base)
+    assert probs and "throughput regression" in probs[0]
+
+
 def test_compare_lrb_stream_gate():
     base = _fresh(lrb_stream=_stream(requests_per_s=200.0,
                                      staleness=0.0))

@@ -128,6 +128,82 @@ class TestGOSS:
         mape = np.mean(np.abs(y - gbm.predict(X)) / np.maximum(y, 1))
         assert mape < 0.3
 
+    def test_goss_hash_mask_is_width_invariant(self):
+        """The hashed sampler draws from the GLOBAL row index, not the
+        array position: the same rows are kept no matter how far the
+        row axis is padded (the property that makes the sample
+        identical under step-cache bucketing AND row sharding, where
+        the legacy positional PRNG draw changes with the width)."""
+        import jax.numpy as jnp
+        X, y = _binary_data(n=600)
+        gbm = lgb.train({"boosting_type": "goss", "objective": "binary",
+                         "verbose": -1}, lgb.Dataset(X, y),
+                        num_boost_round=1, verbose_eval=False,
+                        keep_training_booster=True)
+        hook = gbm._gbdt._sample_hook
+        rng = np.random.default_rng(0)
+        n = 600
+        g = rng.normal(size=(1, n)).astype(np.float32)
+        h = np.ones((1, n), np.float32)
+        key = jnp.asarray([0, 123], jnp.uint32)
+
+        def run(width):
+            gp = np.zeros((1, width), np.float32)
+            gp[:, :n] = g
+            hp = np.zeros((1, width), np.float32)
+            hp[:, :n] = h
+            rv = np.zeros(width, bool)
+            rv[:n] = True
+            go, ho, m = hook(jnp.asarray(gp), jnp.asarray(hp),
+                             jnp.ones(width, jnp.float32), key,
+                             jnp.asarray(rv))
+            return (np.asarray(go)[:, :n], np.asarray(ho)[:, :n],
+                    np.asarray(m)[:n])
+        a, b = run(1024), run(2048)
+        for x, z in zip(a, b):
+            np.testing.assert_array_equal(x, z)
+        # and the sample is live: some rows dropped, some amplified
+        assert 0 < float(a[2].sum()) < n
+        np.testing.assert_array_equal(
+            np.asarray(a[0] != 0).any(), True)
+
+    def test_goss_hash_matches_legacy_quality(self):
+        """tpu_goss_hash=0 keeps the positional-PRNG sampler as a
+        repro oracle; the hashed default must reach the same quality
+        (AUC equivalence, not bit parity — the draws differ)."""
+        from conftest import rank_auc
+        X, y = _binary_data(n=1500, seed=9)
+        out = {}
+        for name, hashed in (("hash", -1), ("legacy", 0)):
+            gbm = lgb.train(
+                {"boosting_type": "goss", "objective": "binary",
+                 "top_rate": 0.2, "other_rate": 0.1,
+                 "learning_rate": 0.1, "verbose": -1,
+                 "tpu_goss_hash": hashed},
+                lgb.Dataset(X, y), num_boost_round=40,
+                verbose_eval=False)
+            out[name] = rank_auc(y, gbm.predict(X))
+        assert out["hash"] > 0.9
+        assert abs(out["hash"] - out["legacy"]) < 0.02
+
+    def test_goss_hash_data_parallel(self):
+        """Hashed GOSS composes with the row-sharding data learner:
+        sampling activates post-warmup and the booster stays
+        registry-eligible."""
+        X, y = _binary_data(n=2000)
+        params = {"boosting_type": "goss", "objective": "binary",
+                  "top_rate": 0.1, "other_rate": 0.1,
+                  "learning_rate": 0.5, "verbose": -1,   # warmup = 2
+                  "tree_learner": "data"}
+        gbm = lgb.train(params, lgb.Dataset(X, y), num_boost_round=6,
+                        verbose_eval=False, keep_training_booster=True)
+        g = gbm._gbdt
+        assert g._cache_eligible
+        first = float(np.asarray(g.records[0].leaf_count).sum())
+        last = float(np.asarray(g.records[-1].leaf_count).sum())
+        assert first == 2000
+        assert 250 < last < 650
+
 
 class TestDART:
     def test_dart_binary(self):

@@ -1,0 +1,209 @@
+"""Out-of-core disk->device ingest (pytest -m ooc).
+
+The promise: ``tpu_out_of_core=1`` routes a file load through the
+two-round streaming pass (io/loader.py _load_two_round) so the [F, N]
+bin matrix assembles from bounded row blocks — BIT-identical to the
+in-memory loader on every route (host bins, single-device device
+stream, row-sharded device stream, libsvm), with peak host memory
+bounded by the block size instead of N. ``tpu_ooc_block_rows`` sizes
+the blocks; ``tpu_out_of_core=0`` pins the host-bins fallback inside
+two_round. ooc/* counters account for the streamed work.
+"""
+import numpy as np
+import pytest
+
+from conftest import TEST_PARAMS, make_binary
+
+pytestmark = pytest.mark.ooc
+
+
+def _cfg(**kw):
+    from lightgbm_tpu.config import Config
+    full = dict(TEST_PARAMS)
+    full.update({"objective": "binary"})
+    full.update(kw)
+    return Config().set(full)
+
+
+def _write_csv(path, X, y):
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",",
+               fmt="%.7g")
+
+
+def _trees(g):
+    return g.model_to_string().split("parameters:")[0]
+
+
+def _train(cfg, ds, rounds=5):
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    obj = create_objective("binary", cfg)
+    obj.init(ds.metadata, ds.num_data)
+    g = GBDT()
+    g.init(cfg, ds, obj, ())
+    for _ in range(rounds):
+        g.train_one_iter()
+    g.finish_training()
+    return g
+
+
+def test_ooc_forced_routes_and_matches_in_memory(tmp_path):
+    """tpu_out_of_core=1 takes the streaming path WITHOUT two_round
+    set, and the binned dataset is bit-identical to the in-memory
+    loader's."""
+    from lightgbm_tpu.io.loader import DatasetLoader
+    from lightgbm_tpu.obs import registry as obs
+
+    X, y = make_binary(n=900, f=6, seed=41)
+    f = tmp_path / "t.csv"
+    _write_csv(f, X, y)
+    ref = DatasetLoader(_cfg()).load_from_file(str(f))
+    b0 = obs.counter("ooc/blocks").value
+    ds = DatasetLoader(_cfg(tpu_out_of_core=1)).load_from_file(str(f))
+    assert obs.counter("ooc/blocks").value > b0, \
+        "forced OOC did not take the streaming path"
+    assert ds.num_data == ref.num_data
+    np.testing.assert_array_equal(ds.bins, ref.bins)
+    np.testing.assert_array_equal(ds.metadata.label, ref.metadata.label)
+
+
+def test_ooc_device_stream_bit_parity_and_counters(tmp_path):
+    """With device ingest on, the OOC route assembles the bin matrix
+    ON DEVICE (no host bin matrix at all) and matches the in-memory
+    loader bit-for-bit; ooc/disk_bytes accounts the streamed text and
+    the peak-RSS gauge is recorded."""
+    from lightgbm_tpu.io.loader import DatasetLoader
+    from lightgbm_tpu.obs import registry as obs
+
+    X, y = make_binary(n=1100, f=6, seed=43)
+    f = tmp_path / "t.csv"
+    _write_csv(f, X, y)
+    ref = DatasetLoader(_cfg()).load_from_file(str(f))
+    d0 = obs.counter("ooc/disk_bytes").value
+    ds = DatasetLoader(_cfg(tpu_out_of_core=1, tpu_ingest=1,
+                            enable_bundle=False)).load_from_file(str(f))
+    assert ds.bins is None and ds.bins_t_dev is not None
+    got = np.asarray(ds.bins_t_dev)[:, :ds.num_data].T
+    np.testing.assert_array_equal(got, ref.bins.astype(got.dtype))
+    streamed = obs.counter("ooc/disk_bytes").value - d0
+    import os
+    assert streamed >= os.path.getsize(f) * 0.9, \
+        "disk_bytes must account (approximately) the whole file"
+    assert (obs.gauge("ooc/rss_peak_mb").value or 0) > 0
+
+
+def test_ooc_off_pins_host_bins(tmp_path):
+    """tpu_out_of_core=0 inside two_round disables the device stream:
+    host bins, still bit-identical."""
+    from lightgbm_tpu.io.loader import DatasetLoader
+
+    X, y = make_binary(n=700, f=5, seed=45)
+    f = tmp_path / "t.csv"
+    _write_csv(f, X, y)
+    ref = DatasetLoader(_cfg()).load_from_file(str(f))
+    ds = DatasetLoader(_cfg(two_round=True, tpu_ingest=1,
+                            tpu_out_of_core=0)).load_from_file(str(f))
+    assert ds.bins_t_dev is None and ds.bins is not None
+    np.testing.assert_array_equal(ds.bins, ref.bins)
+
+
+def test_ooc_block_rows_knob(tmp_path):
+    """tpu_ooc_block_rows sizes the pass-2 blocks: tiny blocks mean
+    many flushes and an IDENTICAL matrix."""
+    from lightgbm_tpu.io.loader import DatasetLoader
+    from lightgbm_tpu.obs import registry as obs
+
+    X, y = make_binary(n=640, f=5, seed=47)
+    f = tmp_path / "t.csv"
+    _write_csv(f, X, y)
+    big = DatasetLoader(_cfg(tpu_out_of_core=1)).load_from_file(str(f))
+    b0 = obs.counter("ooc/blocks").value
+    small = DatasetLoader(_cfg(tpu_out_of_core=1,
+                               tpu_ooc_block_rows=64)
+                          ).load_from_file(str(f))
+    assert obs.counter("ooc/blocks").value - b0 >= 640 // 64
+    np.testing.assert_array_equal(small.bins, big.bins)
+
+
+def test_sharded_stream_matches_in_memory_sharded():
+    """ShardedIngestStream fed odd-sized sequential blocks assembles
+    the SAME row-sharded [F, N_pad] array as bin_matrix_sharded on the
+    whole matrix — identical chunk kernel, identical row->device map."""
+    from lightgbm_tpu.io.dataset import Metadata, TpuDataset
+    from lightgbm_tpu.io.ingest import DeviceBinner
+    from lightgbm_tpu.parallel.learners import make_mesh
+
+    r = np.random.default_rng(7)
+    X = r.normal(size=(1030, 6))
+    X[::13, 3] = np.nan
+    cfg = _cfg(tpu_ingest=1)
+    ds = TpuDataset(cfg).construct_from_matrix(
+        X, Metadata(label=(X[:, 0] > 0).astype(np.float32)))
+    binner = DeviceBinner(ds.mappers, ds.used_feature_map, cfg,
+                          X.dtype)
+    mesh = make_mesh(8)
+    a = binner.bin_matrix_sharded(X, mesh)
+    stream = binner.start_sharded_stream(mesh, X.shape[0])
+    for r0 in range(0, X.shape[0], 97):          # parser-sized blocks
+        stream.feed(X[r0:r0 + 97])
+    b = stream.finish()
+    assert a.shape == b.shape
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ooc_sharded_loader_trains_bit_identical(tmp_path):
+    """File load under a row-sharding learner: the OOC route streams
+    straight into the mesh layout (bins_t_dev + pad) and the trained
+    model is bit-identical to the in-memory loader's."""
+    from lightgbm_tpu.io.loader import DatasetLoader
+
+    X, y = make_binary(n=1000, f=6, seed=49)
+    f = tmp_path / "t.csv"
+    _write_csv(f, X, y)
+    kw = dict(tree_learner="data", tpu_ingest=1, enable_bundle=False)
+    cfg_m = _cfg(**kw)
+    ref = DatasetLoader(cfg_m).load_from_file(str(f))
+    cfg_o = _cfg(tpu_out_of_core=1, **kw)
+    ds = DatasetLoader(cfg_o).load_from_file(str(f))
+    assert ds.bins_t_dev is not None
+    assert ds.bins_t_dev.shape[1] >= ds.num_data
+    g1 = _train(cfg_m, ref)
+    g2 = _train(cfg_o, ds)
+    assert _trees(g1) == _trees(g2)
+
+
+def test_ooc_libsvm_parity(tmp_path):
+    """Sparse-format (libsvm) files ride the same forced-OOC route
+    bit-identically, device stream included."""
+    from lightgbm_tpu.io.loader import DatasetLoader
+
+    X, y = make_binary(n=500, f=5, seed=51)
+    f = tmp_path / "t.svm"
+    with open(f, "w") as fh:
+        for i in range(500):
+            feats = " ".join(f"{j}:{X[i, j]:.6g}" for j in range(5)
+                             if abs(X[i, j]) > 0.05)
+            fh.write(f"{y[i]:.0f} {feats}\n")
+    ref = DatasetLoader(_cfg()).load_from_file(str(f))
+    ds = DatasetLoader(_cfg(tpu_out_of_core=1, tpu_ooc_block_rows=128)
+                       ).load_from_file(str(f))
+    np.testing.assert_array_equal(ds.bins, ref.bins)
+    dd = DatasetLoader(_cfg(tpu_out_of_core=1, tpu_ingest=1,
+                            enable_bundle=False)).load_from_file(str(f))
+    got = np.asarray(dd.bins_t_dev)[:, :dd.num_data].T
+    np.testing.assert_array_equal(got, ref.bins.astype(got.dtype))
+
+
+def test_ooc_train_bit_identical_serial(tmp_path):
+    """End-to-end acceptance: a model trained from the OOC-loaded
+    dataset is BIT-identical to one trained from the in-memory load."""
+    from lightgbm_tpu.io.loader import DatasetLoader
+
+    X, y = make_binary(n=800, f=6, seed=53)
+    f = tmp_path / "t.csv"
+    _write_csv(f, X, y)
+    cfg_m = _cfg()
+    cfg_o = _cfg(tpu_out_of_core=1, tpu_ooc_block_rows=100)
+    g1 = _train(cfg_m, DatasetLoader(cfg_m).load_from_file(str(f)))
+    g2 = _train(cfg_o, DatasetLoader(cfg_o).load_from_file(str(f)))
+    assert _trees(g1) == _trees(g2)

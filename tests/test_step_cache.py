@@ -207,9 +207,7 @@ def test_reset_parameter_cannot_flip_step_implementation():
     assert len(g.records) == 3
 
 
-def test_ineligible_variants_keep_legacy():
-    """GOSS opts out (its in-jit sampler is positional in the row
-    width) — no registry traffic, and the booster still trains."""
+def _goss_booster(extra):
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import Metadata, TpuDataset
     from lightgbm_tpu.models.boosting import create_boosting
@@ -218,22 +216,68 @@ def test_ineligible_variants_keep_legacy():
     p = dict(TEST_PARAMS)
     p.update({"objective": "binary", "boosting": "goss",
               "top_rate": 0.3, "other_rate": 0.3})
+    p.update(extra)
     cfg = Config().set(p)
     ds = TpuDataset(cfg).construct_from_matrix(X, Metadata(label=y))
     obj = create_objective("binary", cfg)
     obj.init(ds.metadata, ds.num_data)
+    g = create_boosting("goss")
+    g.init(cfg, ds, obj, ())
+    for _ in range(3):
+        g.train_one_iter()
+    return g
 
-    def go():
-        g = create_boosting("goss")
-        g.init(cfg, ds, obj, ())
-        for _ in range(3):
-            g.train_one_iter()
-        return g
-    g, d = stats_delta(go)
+
+def test_ineligible_variants_keep_legacy():
+    """Legacy GOSS (tpu_goss_hash=0) opts out — its in-jit sampler is
+    positional in the row width — no registry traffic, and the booster
+    still trains."""
+    g, d = stats_delta(lambda: _goss_booster({"tpu_goss_hash": 0}))
     assert d["misses"] == 0 and d["hits"] == 0
     assert not g._cache_eligible
     assert g._n_score == g._n
     assert len(g.records) == 3
+
+
+def test_goss_hash_rides_registry():
+    """Default (hashed) GOSS is step-cache eligible: first booster
+    misses once, a same-geometry retrain is a pure registry hit, and
+    the two produce identical trees."""
+    g1, d1 = stats_delta(lambda: _goss_booster({}))
+    assert g1._cache_eligible
+    assert d1["misses"] >= 1
+    g2, d2 = stats_delta(lambda: _goss_booster({}))
+    assert d2["misses"] == 0, "same-geometry GOSS retrain recompiled"
+    assert d2["hits"] >= 1
+    assert trees(g1) == trees(g2)
+    assert len(g2.records) == 3
+
+
+def test_lambdarank_rides_registry_and_retrain_hits():
+    """lambdarank's query tables ride the aux pytree (replicated
+    ``_``-keys, bucketed [nq, qmax] shapes): a same-geometry retrain is
+    a pure registry hit and bit-identical — the windows-2+ zero-compile
+    promise for the ranking workload."""
+    rng = np.random.default_rng(31)
+    n, qsize = 1200, 20
+    X = rng.normal(size=(n, 8))
+    y = np.clip((X[:, 0] * 2 + rng.normal(size=n)) // 1.0,
+                0, 3).astype(np.float32)
+    group = np.full(n // qsize, qsize, np.int64)
+    params = {"objective": "lambdarank"}
+    g1, d1 = stats_delta(
+        lambda: fit_gbdt(X, y, params, num_round=4, group=group))
+    assert g1._cache_eligible, "lambdarank must be registry-eligible"
+    assert d1["misses"] >= 1
+    g2, d2 = stats_delta(
+        lambda: fit_gbdt(X, y, params, num_round=4, group=group))
+    assert d2["misses"] == 0, "lambdarank retrain recompiled"
+    assert d2["hits"] >= 1
+    assert trees(g1) == trees(g2)
+    # bucket-vs-exact parity: padded query tables are inert
+    ge = fit_gbdt(X, y, dict(params, tpu_row_bucket=0), num_round=4,
+                  group=group)
+    assert trees(g1) == trees(ge)
 
 
 def test_lrb_two_window_smoke():

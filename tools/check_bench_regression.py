@@ -106,22 +106,28 @@ def check_schema(fresh: dict) -> List[str]:
     """Shape problems in a (normalized) fresh bench artifact — the
     HIGGS-class training line (unit ``M row-iters/s``), the standalone
     ``bench.py --lrb-stream`` line (unit ``requests/s``, details under
-    ``lrb_stream``) or the ``bench.py --sparse`` line (unit ``rows/s``,
-    dense-vs-CSR routes under ``sparse``); a training line may also
-    CARRY an ``lrb_stream`` section (the appended compact stream
-    bench)."""
+    ``lrb_stream``), the ``bench.py --sparse`` line (unit ``rows/s``,
+    dense-vs-CSR routes under ``sparse``) or the ``bench.py --rank``
+    line (also unit ``rows/s`` — the two share the unit, so the
+    section key disambiguates: memory-vs-OOC routes under ``rank``);
+    a training line may also CARRY an ``lrb_stream`` section (the
+    appended compact stream bench)."""
     problems = []
     stream_only = fresh.get("unit") == "requests/s"
-    sparse_only = fresh.get("unit") == "rows/s"
+    rank_only = (fresh.get("unit") == "rows/s"
+                 and isinstance(fresh.get("rank"), (dict, list, str)))
+    sparse_only = fresh.get("unit") == "rows/s" and not rank_only
     if not isinstance(fresh.get("value"), (int, float)):
         problems.append("missing numeric 'value' "
                         + ("(requests/s)" if stream_only
-                           else "(rows/s)" if sparse_only
+                           else "(rows/s)" if sparse_only or rank_only
                            else "(M row-iters/s)"))
     if stream_only:
         if not isinstance(fresh.get("lrb_stream"), dict):
             problems.append("unit requests/s but no 'lrb_stream' "
                             "object")
+    elif rank_only:
+        pass                      # shape gated below with the section
     elif sparse_only:
         if not isinstance(fresh.get("sparse"), dict):
             problems.append("unit rows/s but no 'sparse' object")
@@ -176,6 +182,42 @@ def check_schema(fresh: dict) -> List[str]:
                 problems.append("sparse.model_parity is false: the "
                                 "dense and CSR routes trained "
                                 "different models")
+    rk = fresh.get("rank")
+    if rk is not None:
+        if not isinstance(rk, dict):
+            problems.append(f"rank is {type(rk).__name__}, not a dict")
+        else:
+            routes = rk.get("routes")
+            if not isinstance(routes, dict):
+                problems.append("rank.routes missing/not a dict")
+            else:
+                for rname in ("memory", "ooc"):
+                    r = routes.get(rname)
+                    if not isinstance(r, dict):
+                        problems.append(
+                            f"rank.routes.{rname} missing/not a dict")
+                        continue
+                    for k in ("rows_per_s", "peak_rss_mb"):
+                        if not isinstance(r.get(k), (int, float)):
+                            problems.append(
+                                f"rank.routes.{rname}.{k} missing/null")
+                    nd = r.get("ndcg")
+                    if not (isinstance(nd, dict) and nd
+                            and all(isinstance(v, (int, float))
+                                    for v in nd.values())):
+                        problems.append(
+                            f"rank.routes.{rname}.ndcg missing/not a "
+                            "non-empty dict of numbers")
+            for k in ("peak_rss_ratio", "step_cache_hit_rate"):
+                if not isinstance(rk.get(k), (int, float)):
+                    problems.append(f"rank.{k} missing/null")
+            # OOC's whole promise is BIT parity with the in-memory
+            # loader — diverged models are a correctness bug, not a
+            # perf number
+            if rk.get("model_parity") is False:
+                problems.append("rank.model_parity is false: the "
+                                "in-memory and out-of-core routes "
+                                "trained different models")
     lat = fresh.get("predict_latency")
     if lat is not None:
         if not isinstance(lat, dict):
@@ -356,6 +398,58 @@ def compare(fresh: dict, baseline: dict,
     problems += _compare_lrb_stream(fresh, baseline, throughput_tol,
                                     staleness_slack)
     problems += _compare_parity(fresh, baseline, throughput_tol)
+    problems += _compare_rank(fresh, baseline, auc_tol, latency_tol)
+    return problems
+
+
+def _compare_rank(fresh: dict, baseline: dict, auc_tol: float,
+                  latency_tol: float) -> List[str]:
+    """Rank-bench gate (``rank`` section): NDCG is a quality floor
+    (``--auc-tol``, like test AUC — ranking quality must not silently
+    decay) and the OOC route's peak RSS is a ceiling
+    (``--latency-tol`` fractional slack — RSS creep back toward the
+    in-memory watermark is exactly the regression out-of-core ingest
+    exists to prevent). The headline rows/s floor is the generic
+    ``value`` gate; the metric string embeds the workload shape, so
+    cross-shape comparisons were already refused upstream. Only fires
+    when the BASELINE carries the section; a fresh run that LOST it
+    against a carrier is itself a problem."""
+    br = baseline.get("rank")
+    if not isinstance(br, dict):
+        return []
+    fr = fresh.get("rank")
+    if not isinstance(fr, dict):
+        return ["fresh run carries no rank section to compare"]
+    problems = []
+    bo = (br.get("routes") or {}).get("ooc") or {}
+    fo = (fr.get("routes") or {}).get("ooc") or {}
+    bnd = bo.get("ndcg") if isinstance(bo.get("ndcg"), dict) else {}
+    fnd = fo.get("ndcg") if isinstance(fo.get("ndcg"), dict) else {}
+    for k in sorted(bnd):
+        bq = bnd[k]
+        if not isinstance(bq, (int, float)):
+            continue
+        fq = fnd.get(k)
+        if not isinstance(fq, (int, float)):
+            problems.append(f"fresh run carries no rank ooc {k} "
+                            "to compare")
+        elif fq < bq - auc_tol:
+            problems.append(
+                f"ranking-quality regression: ooc {k} {fq:.5f} < "
+                f"baseline {bq:.5f} - {auc_tol:g}")
+    brss = bo.get("peak_rss_mb")
+    if isinstance(brss, (int, float)):
+        frss = fo.get("peak_rss_mb")
+        if not isinstance(frss, (int, float)):
+            problems.append("fresh run carries no rank ooc "
+                            "peak_rss_mb to compare")
+        else:
+            ceil = (1.0 + latency_tol) * brss
+            if frss > ceil:
+                problems.append(
+                    f"out-of-core RSS regression: ooc peak "
+                    f"{frss:g} MB > {ceil:g} (baseline {brss:g} + "
+                    f"{latency_tol:.0%})")
     return problems
 
 
