@@ -548,6 +548,22 @@ class Config:
     # the system temp dir; run reports cross-link them as
     # meta.flight_dumps. 0 disables the black box.
     tpu_flight_buffer: int = 256
+    # explicit flight-dump directory (obs/flight.py), overriding the
+    # artifact-path default. Multi-process drivers
+    # (parallel/elastic.py) point EVERY rank at one shared directory
+    # so the incident sweep (obs/incident.py) can gather all ranks'
+    # postmortem bundles into a single incident document. Empty = the
+    # first configured artifact path's directory, else the temp dir.
+    tpu_flight_dir: str = ""
+    # cluster-scope metrics rollups (obs/clusterobs.py): each rank
+    # publishes a compact metrics digest into the coordination-service
+    # KV alongside its heartbeat, and rank 0's exporter merges them
+    # into first-class cluster/* instruments (summed counters, true
+    # cluster histogram quantiles, per-rank straggler gauges)
+    # published through the usual Prometheus/JSONL//metrics surfaces.
+    # -1 = auto (on whenever the run is multi-process AND a metrics
+    # exporter is configured); 0 = off; 1 = force on.
+    tpu_cluster_obs: int = -1
     # resumable checkpoints (utils/checkpoint.py): directory for
     # versioned JSON checkpoint bundles — the model text PLUS the
     # training state the model text lacks (iteration, bagging/feature/
@@ -983,6 +999,10 @@ class Config:
             log.warning("tpu_flight_buffer=%d is negative; disabling "
                         "the flight recorder (0)", self.tpu_flight_buffer)
             self.tpu_flight_buffer = 0
+        if self.tpu_cluster_obs not in (-1, 0, 1):
+            log.warning("tpu_cluster_obs=%d is not -1/0/1; using auto "
+                        "(-1)", self.tpu_cluster_obs)
+            self.tpu_cluster_obs = -1
         if self.tpu_slo:
             # refuse a malformed spec at config time, not in the
             # exporter thread mid-run (the parse error names the

@@ -128,6 +128,16 @@ class GBDT:
         from ..ops import predict_cache
         predict_cache.configure(config.tpu_predict_cache,
                                 config.tpu_serve_bucket)
+        # multi-host cluster (parallel/cluster.py): adopt an already-
+        # initialized jax.distributed runtime (the elastic worker
+        # bootstraps BEFORE dataset construction; embedders may too) so
+        # the placement seams below know the mesh spans processes.
+        # Single-process runs return immediately. This runs BEFORE the
+        # obs daemons below: their rank-dependent decisions — export
+        # path suffixing, the rank-0-only HTTP bind, trace/reqlog rank
+        # stamping (obs/identity.py) — need the topology resolved
+        from ..parallel import cluster
+        cluster.initialize_from_config(config)
         # streaming telemetry (obs/): the span tracer and the live
         # metrics exporter are process-global daemons — the first
         # booster with the knobs set starts them, every later one
@@ -148,13 +158,6 @@ class GBDT:
         # tpu_faults knob arms the recovery drills' injection points
         from ..utils import faults
         faults.configure_from_config(config)
-        # multi-host cluster (parallel/cluster.py): adopt an already-
-        # initialized jax.distributed runtime (the elastic worker
-        # bootstraps BEFORE dataset construction; embedders may too) so
-        # the placement seams below know the mesh spans processes.
-        # Single-process runs return immediately.
-        from ..parallel import cluster
-        cluster.initialize_from_config(config)
         self.objective = objective
         self.training_metrics = list(training_metrics)
         self.iter_ = 0

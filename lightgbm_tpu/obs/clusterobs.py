@@ -1,0 +1,328 @@
+"""Cluster-scope metrics: per-rank digests through the coordination
+KV, merged into ``cluster/*`` rollups on rank 0.
+
+The per-process registry (obs/registry.py) stays the accumulation
+point; this module makes the CLUSTER visible from one place:
+
+**Digest** (every rank): ``build_digest()`` compacts the registry into
+a JSON wire record — every counter and gauge by value, every histogram
+as its bucket-count vector plus sum/min/max (schema
+``lightgbm-tpu/obs-digest`` v1). The cluster heartbeat thread
+(parallel/cluster.py) publishes it under ``lgbm_tpu/obs/<rank>/<seq>``
+alongside its liveness key, same cadence discipline: seq in the key,
+previous seq deleted, so the directory holds one digest per rank and a
+reader never blocks on an absent key.
+
+**Rollup** (rank 0): the exporter thread (obs/export.py) calls
+``maybe_refresh_from_kv()`` each interval; digests merge into a fresh
+private ``MetricsRegistry`` holding first-class ``cluster/*``
+instruments —
+
+- ``cluster/<name>`` counter = sum over ranks;
+- ``cluster/<name>`` histogram = elementwise bucket-count sum (ranks
+  share the preset bounds, so quantiles interpolate over the TRUE
+  cluster distribution, not an average of per-rank quantiles);
+- per-rank gauge families ``cluster/iter_wall_mean_s/r<k>`` and
+  ``cluster/psum_stall_s/r<k>`` (cardinality bounded by world size);
+- straggler attribution: ``cluster/psum_stall_max_rank`` and
+  ``cluster/slowest_iter_rank`` name the rank to go look at;
+- ``cluster/ranks_reporting`` / ``cluster/world`` so a missing digest
+  is visible as a number, not an absence.
+
+The merged registry is published through the existing surfaces — the
+exporter folds its snapshot into the ``.prom``/``.jsonl``/``/metrics``
+payloads — and the SLO engine (obs/slo.py) resolves ``cluster/...``
+instrument names against it, so budgets burn on cluster truth instead
+of rank-0's slice.
+
+Stdlib-only like the rest of obs/; the cluster client is always passed
+in or imported lazily.
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..analysis import lockorder
+from . import identity
+from . import registry as _registry
+
+DIGEST_SCHEMA = "lightgbm-tpu/obs-digest"
+DIGEST_VERSION = 1
+
+# KV namespace for per-rank metric digests (next to lgbm_tpu/hb/)
+OBS_PREFIX = "lgbm_tpu/obs/"
+# publish every N heartbeats: digests are ~kilobytes against the
+# heartbeat's bytes, so they ride a slower multiple of the same clock
+DIGEST_EVERY_BEATS = 4
+
+_DIGEST_KEY_RE = re.compile(r"obs/(\d+)/(\d+)$")
+
+_lock = lockorder.named_lock("obs.clusterobs._lock")
+_agg: Optional[_registry.MetricsRegistry] = None   # guarded-by: _lock
+_last_digests: Dict[int, dict] = {}                # guarded-by: _lock
+_pub_seq = 0                                       # guarded-by: _lock
+_enabled = -1   # tpu_cluster_obs: -1 auto / 0 off / 1 on  guarded-by: _lock
+
+
+def configure_from_config(config) -> None:
+    """Latch the ``tpu_cluster_obs`` enablement (the cluster bootstrap
+    calls this with the driving config — the heartbeat thread that
+    publishes has no config in scope)."""
+    from .trace import config_get
+    global _enabled
+    v = int(config_get(config, "tpu_cluster_obs", -1))
+    with _lock:
+        _enabled = v if v in (-1, 0, 1) else -1
+
+
+def enabled() -> bool:
+    """Whether digests publish at all: only ``tpu_cluster_obs=0`` says
+    no. Auto and force both publish under world>1 — digests cost
+    kilobytes, and the rollup half only runs where an exporter thread
+    exists to consume them (obs/export.py)."""
+    with _lock:
+        return _enabled != 0
+
+
+# -- digest build/parse ------------------------------------------------------
+
+
+def build_digest(reg: Optional[_registry.MetricsRegistry] = None
+                 ) -> dict:
+    """This process's registry compacted to the digest wire shape."""
+    reg = reg or _registry.default_registry()
+    snap_hists = {}
+    with reg._lock:
+        counters = {n: c._value for n, c in reg._counters.items()}
+        gauges = {n: g._value for n, g in reg._gauges.items()
+                  if g._value is not None}
+        hists = list(reg._histograms.items())
+    for name, h in hists:
+        with h._lock:
+            if not h._count:
+                continue
+            snap_hists[name] = {
+                "b": list(h.buckets),
+                "c": list(h._counts),
+                "sum": h._sum,
+                "min": h._min,
+                "max": h._max,
+            }
+    return {
+        "schema": DIGEST_SCHEMA,
+        "version": DIGEST_VERSION,
+        "identity": identity.identity(),
+        "counters": counters,
+        "gauges": gauges,
+        "hists": snap_hists,
+    }
+
+
+def digest_to_wire(digest: dict) -> str:
+    return json.dumps(digest, separators=(",", ":"))
+
+
+def digest_from_wire(raw: str) -> Optional[dict]:
+    """Parse one digest value; None for anything malformed (a reader
+    must never die on a truncated KV write)."""
+    try:
+        d = json.loads(raw)
+    except (TypeError, ValueError):
+        return None
+    if not isinstance(d, dict) or d.get("schema") != DIGEST_SCHEMA \
+            or d.get("version") != DIGEST_VERSION:
+        return None
+    return d
+
+
+# -- KV publish / read -------------------------------------------------------
+
+
+def publish_digest(client, rank_n: int) -> bool:
+    """Push this rank's current digest under ``lgbm_tpu/obs/<rank>/
+    <seq>``, deleting the previous seq — the heartbeat key discipline.
+    False when the client refused (coordinator gone)."""
+    global _pub_seq
+    with _lock:
+        seq = _pub_seq
+        _pub_seq += 1
+    wire = digest_to_wire(build_digest())
+    try:
+        client.key_value_set(f"{OBS_PREFIX}{rank_n}/{seq}", wire)
+        if seq:
+            client.key_value_delete(f"{OBS_PREFIX}{rank_n}/{seq - 1}")
+    except Exception:
+        return False
+    return True
+
+
+def publish_now() -> bool:
+    """Synchronous digest push over the live cluster client (the
+    end-of-run flush in parallel/elastic.py — the periodic heartbeat
+    ride-along may not have fired since the last iteration)."""
+    if not enabled():
+        return False
+    from ..parallel import cluster
+    client = cluster._client()
+    if client is None:
+        return False
+    return publish_digest(client, cluster.rank())
+
+
+def read_digests(client) -> Dict[int, dict]:
+    """rank -> newest parseable digest from the KV directory."""
+    try:
+        entries = client.key_value_dir_get(OBS_PREFIX)
+    except Exception:
+        return {}
+    newest: Dict[int, Tuple[int, str]] = {}
+    for key, value in entries:
+        m = _DIGEST_KEY_RE.search(key)
+        if not m:
+            continue
+        r, seq = int(m.group(1)), int(m.group(2))
+        if r not in newest or seq > newest[r][0]:
+            newest[r] = (seq, value)
+    out: Dict[int, dict] = {}
+    for r, (_seq, value) in newest.items():
+        d = digest_from_wire(value)
+        if d is not None:
+            out[r] = d
+    return out
+
+
+# -- rollup merge ------------------------------------------------------------
+
+
+def merge_digests(digests: Dict[int, dict],
+                  world_n: Optional[int] = None
+                  ) -> _registry.MetricsRegistry:
+    """Build a fresh registry of first-class ``cluster/*`` instruments
+    from per-rank digests. Pure function of its inputs — the unit
+    tests drive it without any KV."""
+    agg = _registry.MetricsRegistry()
+    world_n = int(world_n if world_n is not None
+                  else (max(digests) + 1 if digests else 0))
+    agg.gauge("cluster/world").set(world_n)
+    agg.gauge("cluster/ranks_reporting").set(len(digests))
+    # summed counters: cluster/<name> accumulates every rank's value
+    for r in sorted(digests):
+        for name, v in (digests[r].get("counters") or {}).items():
+            # bounded-cardinality: one series per per-process counter
+            # name — the per-rank dimension is summed away here
+            agg.counter(f"cluster/{name}").add(v)
+    # merged histograms: same preset bounds -> elementwise sum; a rank
+    # whose bounds differ (version skew mid-rollout) is skipped for
+    # that instrument rather than poisoning the quantiles
+    bounds_by_name: Dict[str, List[float]] = {}
+    for r in sorted(digests):
+        for name, h in (digests[r].get("hists") or {}).items():
+            b = [float(x) for x in h.get("b") or []]
+            if not b:
+                continue
+            bounds_by_name.setdefault(name, b)
+            if b != bounds_by_name[name]:
+                continue
+            # bounded-cardinality: one series per per-process
+            # histogram name — ranks merge into it
+            agg.histogram(f"cluster/{name}", tuple(b)).merge_counts(
+                h.get("c") or [0] * (len(b) + 1),
+                h.get("sum") or 0.0, h.get("min"), h.get("max"))
+    # per-rank gauge families + straggler attribution. Two families is
+    # deliberate: stall and iteration wall are the straggler evidence;
+    # everything else stays summed or per-process.
+    stall_by_rank: Dict[int, float] = {}
+    iter_by_rank: Dict[int, float] = {}
+    for r in sorted(digests):
+        d = digests[r]
+        stall = (d.get("counters") or {}).get("comm/psum_stall_s")
+        if stall is not None:
+            stall_by_rank[r] = float(stall)
+            # bounded-cardinality: one series per rank, world-sized
+            agg.gauge(f"cluster/psum_stall_s/r{r}").set(float(stall))
+        h = (d.get("hists") or {}).get("train/iteration_s")
+        if h and h.get("c"):
+            cnt = sum(int(c) for c in h["c"])
+            if cnt:
+                mean = float(h.get("sum") or 0.0) / cnt
+                iter_by_rank[r] = mean
+                # bounded-cardinality: one series per rank, world-sized
+                agg.gauge(f"cluster/iter_wall_mean_s/r{r}").set(mean)
+    if stall_by_rank and any(stall_by_rank.values()):
+        agg.gauge("cluster/psum_stall_max_rank").set(
+            max(stall_by_rank, key=stall_by_rank.get))
+    if iter_by_rank:
+        agg.gauge("cluster/slowest_iter_rank").set(
+            max(iter_by_rank, key=iter_by_rank.get))
+    return agg
+
+
+def missing_ranks(digests: Dict[int, dict], world_n: int) -> List[int]:
+    return [r for r in range(int(world_n)) if r not in digests]
+
+
+# -- rank-0 refresh + published views ---------------------------------------
+
+
+def refresh_from_kv() -> bool:
+    """Read every rank's newest digest and rebuild the aggregated
+    registry. True when at least one digest merged. Call sites gate on
+    rank 0 (``maybe_refresh_from_kv``); calling this elsewhere is
+    harmless, just wasted reads."""
+    from ..parallel import cluster
+    client = cluster._client()
+    if client is None:
+        return False
+    digests = read_digests(client)
+    if not digests:
+        return False
+    agg = merge_digests(digests, world_n=cluster.world())
+    global _agg
+    with _lock:
+        _agg = agg
+        _last_digests.clear()
+        _last_digests.update(digests)
+    return True
+
+
+def maybe_refresh_from_kv() -> bool:
+    """The exporter-thread entry: refresh only on rank 0 of a live
+    multi-process cluster (other ranks publish, they never merge)."""
+    from ..parallel import cluster
+    if not cluster.is_multiprocess() or cluster.rank() != 0:
+        return False
+    return refresh_from_kv()
+
+
+def aggregated_registry() -> Optional[_registry.MetricsRegistry]:
+    """The current ``cluster/*`` rollup registry (rank 0 after at
+    least one merge), or None. The SLO engine resolves ``cluster/...``
+    instrument names against this."""
+    with _lock:
+        return _agg
+
+
+def last_digests() -> Dict[int, dict]:
+    """The digest set behind the current rollup — the incident bundle
+    embeds this as the cluster's final state (obs/incident.py)."""
+    with _lock:
+        return dict(_last_digests)
+
+
+def cluster_snapshot() -> Optional[dict]:
+    """Snapshot of the aggregated registry for the exporter to fold
+    into its per-interval snapshot; None before the first merge."""
+    with _lock:
+        agg = _agg
+    return agg.snapshot() if agg is not None else None
+
+
+def reset() -> None:
+    """Drop merge state (tests)."""
+    global _agg, _pub_seq
+    with _lock:
+        _agg = None
+        _last_digests.clear()
+        _pub_seq = 0

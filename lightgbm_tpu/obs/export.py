@@ -50,6 +50,7 @@ import time
 from typing import Optional
 
 from ..utils.fileio import atomic_write
+from . import identity
 from .registry import MetricsRegistry, default_registry
 from .trace import config_get
 
@@ -94,6 +95,14 @@ def prometheus_text(snapshot: dict) -> str:
         lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name}{labels} {value}")
 
+    ident = snapshot.get("identity")
+    if isinstance(ident, dict):
+        # rank identity as an info-style gauge: constant 1, the record
+        # in the labels — the Prometheus idiom for build/identity facts
+        labels = ",".join(f'{k}="{ident[k]}"' for k in
+                          ("machine_rank", "world", "incarnation")
+                          if k in ident)
+        emit(_PREFIX + "identity_info", "gauge", "1", "{" + labels + "}")
     for name, v in snapshot.get("counters", {}).items():
         emit(_prom_name(name) + "_total", "counter", _fmt(v))
     for name, v in snapshot.get("gauges", {}).items():
@@ -227,6 +236,18 @@ class MetricsExporter:
         snap = self._reg.snapshot()
         snap["ts"] = round(time.time(), 3)
         snap["uptime_s"] = round(time.monotonic() - self._t0, 3)
+        snap["identity"] = identity.identity()
+        # rank-0 cluster rollups (obs/clusterobs.py) fold into the
+        # same snapshot the .prom/.jsonl//metrics surfaces publish —
+        # only for the default-registry exporter (a private test
+        # registry must not inherit global cluster state)
+        if self._reg is default_registry():
+            from . import clusterobs
+            cs = clusterobs.cluster_snapshot()
+            if cs is not None:
+                for domain in ("counters", "gauges", "histograms"):
+                    snap.setdefault(domain, {}).update(
+                        cs.get(domain) or {})
         return snap
 
     def last_snapshot_age_s(self) -> Optional[float]:
@@ -247,6 +268,17 @@ class MetricsExporter:
             eng.evaluate()
 
     def _write_once(self) -> None:
+        # the exporter interval is ALSO the rollup clock: rank 0 pulls
+        # every rank's newest digest from the coordination KV before
+        # evaluating SLOs, so cluster/* instruments are fresh for both
+        # the SLO engine and the snapshot below (no-op off rank 0 or
+        # single-process)
+        if self._reg is default_registry():
+            from . import clusterobs
+            try:
+                clusterobs.maybe_refresh_from_kv()
+            except Exception:       # noqa: BLE001 — telemetry aid
+                pass
         self._evaluate_slo()
         if not self.base_path:
             # HTTP-only mode: no files, but the tick still snapshots —
@@ -415,6 +447,15 @@ def ensure_from_config(config) -> Optional[MetricsExporter]:
     port = int(config_get(config, "tpu_metrics_port", 0) or 0)
     if not base and port <= 0:
         return None
+    # cluster policy (obs/identity.py): every rank gets its own file
+    # target (no more atomic-replace races on one .prom), and only
+    # rank 0 serves HTTP — by policy, not by bind-failure accident
+    base = identity.rank_suffixed(base)
+    if port > 0 and identity.is_multiprocess() and identity.rank() != 0:
+        from ..utils import log
+        log.info("metrics HTTP endpoint is rank-0-only; rank %d "
+                 "exports to files/ring only", identity.rank())
+        port = 0
     interval = float(config_get(config, "tpu_metrics_interval_s",
                                 DEFAULT_INTERVAL_S)
                      or DEFAULT_INTERVAL_S)

@@ -54,6 +54,7 @@ from typing import List, Optional
 from ..analysis import lockorder
 from ..utils import log
 from ..utils.fileio import atomic_write
+from . import identity
 from . import trace as _trace
 from .registry import MetricsRegistry, default_registry
 from .trace import config_get
@@ -190,6 +191,7 @@ class FlightRecorder:
             "version": FLIGHT_VERSION,
             "created_unix": round(time.time(), 3),
             "pid": os.getpid(),
+            "identity": identity.identity(),
             "reason": str(reason),
             "context": context or {},
             "triggers": triggers,
@@ -213,7 +215,12 @@ class FlightRecorder:
                 self._seq += 1
                 seq = self._seq
                 self._pending = None
-            name = (f"flight_p{os.getpid()}_{seq:03d}_"
+            # rank segment under world>1: N ranks dumping into one
+            # shared directory (the incident sweep's precondition,
+            # obs/incident.py) must never collide on a name
+            rtag = (f"r{identity.rank()}_"
+                    if identity.is_multiprocess() else "")
+            name = (f"flight_{rtag}p{os.getpid()}_{seq:03d}_"
                     f"{_REASON_RE.sub('_', str(reason))[:40]}.json")
             path = os.path.join(self.directory, name)
             with atomic_write(path) as fh:
@@ -362,7 +369,13 @@ def configure(capacity: int = DEFAULT_BUFFER, directory: str = "",
 
 def _dump_dir_from_config(config) -> str:
     """The first configured artifact path names the dump directory —
-    postmortems land next to the run's other evidence."""
+    postmortems land next to the run's other evidence.
+    ``tpu_flight_dir`` overrides: multi-process drivers point every
+    rank at ONE shared directory so the incident sweep
+    (obs/incident.py) can collect all ranks' bundles."""
+    d = str(config_get(config, "tpu_flight_dir", "") or "")
+    if d:
+        return d
     for knob in ("tpu_run_report", "tpu_reqlog", "tpu_metrics_export",
                  "tpu_trace"):
         p = str(config_get(config, knob, "") or "")

@@ -1,0 +1,109 @@
+"""Process-wide observability identity: (machine_rank, world, incarnation).
+
+Every telemetry surface in obs/ — metrics snapshots, trace events,
+reqlog wide events, flight bundles, run-report meta — stamps the SAME
+identity record, so artifacts from N ranks of one cluster correlate
+without filename archaeology:
+
+- ``machine_rank`` / ``world``: this process's rank in the cluster
+  (parallel/cluster.py pushes them here at bootstrap/adoption time —
+  this module never imports the cluster layer, it is a stdlib-only
+  leaf like the rest of obs/).
+- ``incarnation``: bumped on every elastic re-shard this process
+  lives through (utils/checkpoint.py restore's elastic path — the
+  authoritative seam every re-shard funnels through, whether driven
+  by the autoscale controller or an elastic resume onto a new mesh).
+  Telemetry emitted before and after a re-shard carries different
+  incarnations, so a merged timeline can attribute a metric to the
+  world size that produced it.
+
+Path policy: ``rank_suffixed(path)`` inserts ``.r<rank>`` before the
+final extension when world > 1 (``metrics.prom`` -> ``metrics.r1.prom``)
+and leaves single-process paths byte-identical — the fix for the PR-6
+export collision where two same-host ranks raced one atomic-replace
+target. obs/export.py, obs/trace.py, obs/reqlog.py and obs/flight.py
+all route their artifact paths through it.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+__all__ = [
+    "identity", "rank", "world", "incarnation", "is_multiprocess",
+    "set_topology", "bump_incarnation", "rank_suffixed", "log_tag",
+]
+
+_lock = threading.Lock()
+_state: Dict[str, int] = {      # guarded-by: _lock
+    "machine_rank": 0,
+    "world": 1,
+    "incarnation": 0,
+}
+
+
+def identity() -> Dict[str, int]:
+    """The current identity record, ready to embed in an artifact."""
+    with _lock:
+        return dict(_state)
+
+
+def rank() -> int:
+    return _state["machine_rank"]
+
+
+def world() -> int:
+    return _state["world"]
+
+
+def incarnation() -> int:
+    return _state["incarnation"]
+
+
+def is_multiprocess() -> bool:
+    return _state["world"] > 1
+
+
+def set_topology(machine_rank: int, world_n: int) -> None:
+    """Record this process's place in the cluster — called by
+    parallel/cluster.py at bootstrap/adoption (the one writer besides
+    the re-shard bump). Idempotent for a repeated identical call."""
+    with _lock:
+        _state["machine_rank"] = int(machine_rank)
+        _state["world"] = max(int(world_n), 1)
+
+
+def bump_incarnation(reason: str = "") -> int:
+    """Advance the incarnation counter (one elastic re-shard lived
+    through) and return the new value. The caller is the checkpoint
+    restore's elastic re-shard branch (utils/checkpoint.py)."""
+    with _lock:
+        _state["incarnation"] += 1
+        new = _state["incarnation"]
+    # log lazily: utils/log is a leaf too, but keep import out of the
+    # hot module-load path
+    from ..utils import log
+    log.info("obs identity: incarnation -> %d%s", new,
+             f" ({reason})" if reason else "")
+    return new
+
+
+def rank_suffixed(path: str, rank_n: Optional[int] = None) -> str:
+    """``path`` with ``.r<rank>`` inserted before the final extension
+    when world > 1 (or when an explicit ``rank_n`` is given); returned
+    unchanged single-process so existing single-rank artifact paths
+    stay byte-identical."""
+    if not path:
+        return path
+    r = rank_n if rank_n is not None else rank()
+    if rank_n is None and not is_multiprocess():
+        return path
+    base, ext = os.path.splitext(path)
+    return f"{base}.r{int(r)}{ext}" if ext else f"{path}.r{int(r)}"
+
+
+def log_tag() -> str:
+    """The rank tag the log prefix carries (``r1``) — empty
+    single-process so single-rank stderr stays byte-identical."""
+    return f"r{rank()}" if is_multiprocess() else ""

@@ -1,0 +1,192 @@
+"""Distributed incidents: N per-rank flight bundles + the cluster's
+final digest state, assembled into ONE document.
+
+A multi-process failure leaves its evidence scattered: the victim's
+flight recorder dumped a bundle just before SIGKILL (the pre-kill
+fault hook, utils/faults.py), each survivor dumped its own on
+PeerLostError / DeadlineGuard, and the coordination KV still holds the
+last metrics digest every rank published (obs/clusterobs.py). Each
+artifact names one process; the operator's question spans all of them.
+This module answers it with a single **incident bundle** (schema
+``lightgbm-tpu/incident`` v1, atomic write):
+
+- ``dead_ranks`` — who died, as the survivor's liveness scan named
+  them (parallel/cluster.py dead_ranks);
+- ``ranks`` — every reachable rank's flight bundles, EMBEDDED (the
+  per-rank files stay on disk, but the incident document is
+  self-contained — one file to attach to a report);
+- ``digests`` — the final per-rank metrics digest snapshot out of the
+  KV, the cluster's last agreed-upon state;
+- the assembling survivor's own identity, so "who wrote this" is
+  never a guess.
+
+Assembly happens where the shared filesystem is: the elastic driver
+(parallel/elastic.py) points every rank's flight recorder at ONE
+directory (``tpu_flight_dir``), the survivor exit path sweeps it, and
+``run_drill`` re-sweeps after the processes exit so late dumps (the
+victim's pre-kill bundle flushes during teardown) still land in the
+final document. ``tools/trace_summary.py --merge`` renders the
+embedded bundles' spans on one aligned timeline.
+
+Standard library only, like the rest of obs/.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional
+
+from ..utils.fileio import atomic_write
+from . import identity
+
+__all__ = [
+    "INCIDENT_SCHEMA", "INCIDENT_VERSION",
+    "sweep_flight_dumps", "build_incident", "write_incident",
+    "load_incident",
+]
+
+INCIDENT_SCHEMA = "lightgbm-tpu/incident"
+INCIDENT_VERSION = 1
+
+_RANK_IN_NAME_RE = re.compile(r"flight_r(\d+)_")
+
+
+def _rank_of(path: str, doc: dict) -> int:
+    """The rank a flight bundle belongs to: the embedded identity
+    stamp, else the ``flight_r<k>_`` filename segment, else 0 (a
+    single-process dump pre-dating the rank tag)."""
+    ident = doc.get("identity")
+    if isinstance(ident, dict) and "machine_rank" in ident:
+        try:
+            return int(ident["machine_rank"])
+        except (TypeError, ValueError):
+            pass
+    m = _RANK_IN_NAME_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
+def sweep_flight_dumps(directory: str) -> Dict[int, List[dict]]:
+    """rank -> [{"path", "bundle"}, ...] for every parseable
+    ``flight_*.json`` in ``directory``, oldest first per rank.
+    Unparseable files are skipped (a process killed mid-write must not
+    sink the sweep)."""
+    by_rank: Dict[int, List[tuple]] = {}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return {}
+    for name in names:
+        if not (name.startswith("flight_") and name.endswith(".json")):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        r = _rank_of(path, doc)
+        by_rank.setdefault(r, []).append(
+            (doc.get("created_unix") or 0, path, doc))
+    out: Dict[int, List[dict]] = {}
+    for r, entries in by_rank.items():
+        entries.sort(key=lambda e: e[0])
+        out[r] = [{"path": p, "bundle": d} for _t, p, d in entries]
+    return out
+
+
+def build_incident(reason: str, directory: str,
+                   dead_ranks: Optional[List[int]] = None,
+                   context: Optional[dict] = None) -> dict:
+    """Assemble the incident document from every reachable per-rank
+    flight bundle in ``directory`` plus the last KV digest snapshot.
+    Pure best-effort on every input: a partial incident beats none."""
+    from . import clusterobs
+    try:
+        # the survivor may still have a live coordinator (it IS the
+        # coordinator when rank 0 survives): pull the freshest digests
+        clusterobs.refresh_from_kv()
+    except Exception:                   # noqa: BLE001 — best effort
+        pass
+    per_rank = sweep_flight_dumps(directory)
+    ident = identity.identity()
+    return {
+        "schema": INCIDENT_SCHEMA,
+        "version": INCIDENT_VERSION,
+        "created_unix": round(time.time(), 3),
+        "reason": str(reason),
+        "context": context or {},
+        "identity": ident,              # who assembled this document
+        "world": ident.get("world"),
+        "dead_ranks": sorted(int(r) for r in (dead_ranks or [])),
+        "ranks_with_dumps": sorted(per_rank),
+        # JSON object keys are strings; the reader casts back
+        "ranks": {str(r): per_rank[r] for r in sorted(per_rank)},
+        "digests": clusterobs.last_digests(),
+    }
+
+
+def write_incident(reason: str, directory: str,
+                   dead_ranks: Optional[List[int]] = None,
+                   context: Optional[dict] = None,
+                   out_path: str = "") -> Optional[str]:
+    """Build + atomically write the incident bundle (default:
+    ``incident_<reason>.json`` in the swept directory). Never raises —
+    incident assembly runs on a dying process's exit path."""
+    try:
+        doc = build_incident(reason, directory, dead_ranks, context)
+        if not out_path:
+            safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(reason))[:40]
+            out_path = os.path.join(directory, f"incident_{safe}.json")
+        with atomic_write(out_path) as fh:
+            json.dump(doc, fh)
+        from ..utils import log
+        log.warning("incident bundle (%s): %d rank(s)' flight dumps, "
+                    "dead ranks %s -> %s", reason,
+                    len(doc["ranks"]), doc["dead_ranks"] or "none",
+                    out_path)
+        return out_path
+    except Exception:                   # noqa: BLE001 — see docstring
+        return None
+
+
+def resweep(path: str, directory: str) -> Optional[dict]:
+    """Refresh an existing incident bundle's flight-dump sweep: a
+    victim's pre-kill bundle can flush to disk AFTER the survivor
+    assembled the incident (teardown races the sweep), so the drill
+    driver (parallel/elastic.py run_drill) re-sweeps once every
+    process has exited. Digests and provenance are kept from the
+    original — the parent has no KV to re-read. Returns the updated
+    document (also rewritten in place), or None when ``path`` is not a
+    readable incident bundle."""
+    try:
+        doc = load_incident(path)
+    except (OSError, ValueError):
+        return None
+    per_rank = sweep_flight_dumps(directory)
+    doc["ranks_with_dumps"] = sorted(per_rank)
+    doc["ranks"] = {str(r): per_rank[r] for r in sorted(per_rank)}
+    try:
+        with atomic_write(path) as fh:
+            json.dump(doc, fh)
+    except OSError:
+        pass
+    return doc
+
+
+def load_incident(path: str) -> dict:
+    """Parse + validate an incident bundle; ValueError on any other
+    schema/version (the repo's versioned-artifact discipline)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != INCIDENT_SCHEMA:
+        raise ValueError(f"{path}: not an incident bundle "
+                         f"(schema={doc.get('schema')!r})")
+    if doc.get("version") != INCIDENT_VERSION:
+        raise ValueError(f"{path}: incident version "
+                         f"{doc.get('version')!r}, reader wants "
+                         f"{INCIDENT_VERSION}")
+    return doc

@@ -45,6 +45,7 @@ from typing import Dict, List, Optional
 
 from ..analysis import lockorder
 from ..utils import log, timing
+from . import identity
 from . import trace
 from .registry import MetricsRegistry, default_registry
 
@@ -79,7 +80,9 @@ class RunRecorder:
     def __init__(self, path: str = "", watchdog_factor: float = 0.0,
                  meta: Optional[dict] = None,
                  registry: Optional[MetricsRegistry] = None):
-        self.path = path or ""
+        # one report per rank under world>1 (obs/identity.py) — N
+        # ranks handed the same tpu_run_report must never clobber
+        self.path = identity.rank_suffixed(path or "")
         self.watchdog_factor = float(watchdog_factor or 0.0)
         self.meta = dict(meta or {})
         self._reg = registry or default_registry()
@@ -268,6 +271,9 @@ class RunRecorder:
         dumps = flight.dump_paths()
         if dumps:
             self.meta.setdefault("flight_dumps", dumps)
+        # who produced this report: rank/world/incarnation — the key
+        # a cross-rank investigation joins artifacts on
+        self.meta.setdefault("identity", identity.identity())
         if leaves_per_iteration is not None:
             for i, grp in enumerate(leaves_per_iteration):
                 self._rec(i + 1)["leaves"] = [int(x) for x in grp]

@@ -165,6 +165,38 @@ class Histogram:
         with self._lock:
             return self._sum
 
+    def bucket_counts(self) -> List[int]:
+        """The raw per-bucket counts (overflow bucket last) — the
+        mergeable wire form of this histogram: two histograms over the
+        SAME bounds merge by elementwise sum (obs/clusterobs.py builds
+        cluster-wide quantiles this way)."""
+        with self._lock:
+            return list(self._counts)
+
+    def merge_counts(self, counts, sum_: float,
+                     min_: Optional[float],
+                     max_: Optional[float]) -> None:
+        """Fold another histogram's bucket counts into this one —
+        ``counts`` must cover this instrument's bounds plus the
+        overflow bucket. min/max fold exactly, so percentile()'s
+        range clamping stays correct on the merged instrument."""
+        counts = [int(c) for c in counts]
+        if len(counts) != len(self._counts):
+            raise ValueError(
+                f"cannot merge {len(counts)} bucket counts into a "
+                f"{len(self._counts)}-bucket histogram — bounds differ")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._count += sum(counts)
+            self._sum += float(sum_)
+            if min_ is not None:
+                self._min = (float(min_) if self._min is None
+                             else min(self._min, float(min_)))
+            if max_ is not None:
+                self._max = (float(max_) if self._max is None
+                             else max(self._max, float(max_)))
+
     def percentile(self, q: float) -> Optional[float]:
         """q-quantile (0 < q <= 1) with linear interpolation INSIDE the
         bucket holding the quantile rank: the rank's fractional position

@@ -45,6 +45,7 @@ from contextlib import contextmanager
 from typing import Optional
 
 from ..analysis import lockorder
+from . import identity
 from .trace import config_get
 
 __all__ = [
@@ -177,6 +178,13 @@ class RequestLog:
         rec = {"ts": round(time.time(), 6), "kind": str(kind)}
         if req_id is not None:
             rec["req_id"] = int(req_id)
+        if identity.is_multiprocess():
+            # every wide event carries its rank under world>1, so N
+            # ranks' files interleave attributably (obs/identity.py);
+            # single-process records stay byte-identical
+            rec["rank"] = identity.rank()
+            if identity.incarnation():
+                rec["inc"] = identity.incarnation()
         for k, v in fields.items():
             if v is not None:
                 rec[k] = v
@@ -200,6 +208,7 @@ class RequestLog:
                         "kind": "header", "schema": REQLOG_SCHEMA,
                         "version": REQLOG_VERSION,
                         "sample": self.sample,
+                        "identity": identity.identity(),
                         "started_unix": round(time.time(), 3)}) + "\n")
                 self._fh.write(json.dumps(rec) + "\n")
                 self._fh.flush()
@@ -259,6 +268,9 @@ def ensure_from_config(config) -> Optional[RequestLog]:
     the running log (one request log per process, like the exporter)."""
     global _global
     path = str(config_get(config, "tpu_reqlog", "") or "")
+    # one wide-event file per rank under world>1 (obs/identity.py) —
+    # append-mode interleave across processes would tear records
+    path = identity.rank_suffixed(path)
     sample = float(config_get(config, "tpu_reqlog_sample", 1.0))
     with _global_lock:
         if _global is None:

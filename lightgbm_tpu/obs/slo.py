@@ -252,6 +252,19 @@ class SloEngine:
 
     # -- per-spec reads ------------------------------------------------------
 
+    def _registry_for(self, name: str) -> MetricsRegistry:
+        """``cluster/...`` instruments resolve against the rank-0
+        rollup registry (obs/clusterobs.py) when one exists — budgets
+        on cluster objectives burn on cluster truth, not rank-0's
+        slice. Everything else (and any rank before the first merge)
+        reads this engine's own registry."""
+        if name.startswith("cluster/"):
+            from . import clusterobs
+            agg = clusterobs.aggregated_registry()
+            if agg is not None:
+                return agg
+        return self._reg
+
     # bounded-cardinality: every dynamic metric name in this method
     # is a source from the parsed tpu_slo spec list (validated at
     # config time) — one series per configured objective
@@ -259,7 +272,7 @@ class SloEngine:
         """-> (current, total_events, bad_events) for one spec; current
         is in the spec's display unit."""
         if spec.kind == "quantile":
-            h = self._reg.histogram(spec.source)
+            h = self._registry_for(spec.source).histogram(spec.source)
             # ONE consistent read: total and the <=-threshold count
             # must come from the same instant or concurrent observes
             # make bad negative (and corrupt the next burn delta)
@@ -277,12 +290,13 @@ class SloEngine:
             # concurrent window can only make the ratio smaller —
             # never show a bad event without its denominator (which
             # would overshoot the rate and falsely latch exhaustion)
-            num = self._reg.counter(spec.source).value
-            den = self._reg.counter(spec.source_den).value
+            src_reg = self._registry_for(spec.source)
+            num = src_reg.counter(spec.source).value
+            den = src_reg.counter(spec.source_den).value
             cur = (num / den) if den else None
             return cur, den, num
         # gauge: ticks are counted by evaluate()
-        cur = self._reg.gauge(spec.source).value
+        cur = self._registry_for(spec.source).gauge(spec.source).value
         return cur, None, None
 
     # -- evaluation ----------------------------------------------------------

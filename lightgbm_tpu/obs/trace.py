@@ -58,6 +58,7 @@ from typing import Optional
 
 from ..analysis import lockorder
 from ..utils.fileio import atomic_write
+from . import identity
 
 __all__ = [
     "Tracer", "configure", "ensure_from_config", "stop", "active",
@@ -123,7 +124,22 @@ def _sink_only_event(name: str, cat: str, ph: str, ts_us: float,
         ev["s"] = "t"
     if args:
         ev["args"] = args
+    _stamp_rank(ev)
     _feed_sinks(ev)
+
+
+def _stamp_rank(ev: dict) -> None:
+    """Rank (and, once past the first re-shard, incarnation) into the
+    event args under a multi-process world — per-event identity so a
+    merged timeline (tools/trace_summary.py --merge) attributes every
+    span without filename context. Free single-process."""
+    if not identity.is_multiprocess():
+        return
+    args = ev.setdefault("args", {})
+    args.setdefault("rank", identity.rank())
+    inc = identity.incarnation()
+    if inc:
+        args.setdefault("inc", inc)
 
 
 def _sink_now_us() -> float:
@@ -173,6 +189,7 @@ class Tracer:
     # -- recording -----------------------------------------------------------
 
     def _append(self, ev: dict) -> None:
+        _stamp_rank(ev)
         with self._lock:
             if len(self._events) == self.capacity:
                 self._dropped += 1
@@ -240,8 +257,18 @@ class Tracer:
             events = list(self._events)
             threads = dict(self._threads)
             dropped = self._dropped
+        ident = identity.identity()
+        pname = "lightgbm_tpu"
+        if ident["world"] > 1:
+            pname = f"lightgbm_tpu r{ident['machine_rank']}"
         meta = [{"name": "process_name", "ph": "M", "pid": self._pid,
-                 "tid": 0, "args": {"name": "lightgbm_tpu"}}]
+                 "tid": 0, "args": {"name": pname}},
+                # the full identity record as process metadata, so a
+                # merged multi-rank file keeps each process labeled
+                {"name": "process_labels", "ph": "M", "pid": self._pid,
+                 "tid": 0, "args": {"labels": (
+                     f"rank {ident['machine_rank']}/{ident['world']} "
+                     f"inc {ident['incarnation']}")}}]
         for tid, tname in sorted(threads.items()):
             meta.append({"name": "thread_name", "ph": "M",
                          "pid": self._pid, "tid": tid,
@@ -254,6 +281,7 @@ class Tracer:
                 "version": 1,
                 "started_unix": round(self._started_unix, 3),
                 "dropped_events": dropped,
+                "identity": ident,
             },
         }
 
@@ -308,6 +336,9 @@ def ensure_from_config(config) -> Optional[Tracer]:
     path = str(config_get(config, "tpu_trace", "") or "")
     if not path:
         return None
+    # one trace file per rank (obs/identity.py): world>1 must never
+    # atomic-replace a peer's buffer with its own
+    path = identity.rank_suffixed(path)
     cap = int(config_get(config, "tpu_trace_buffer",
                          DEFAULT_BUFFER_EVENTS) or DEFAULT_BUFFER_EVENTS)
     return configure(path, cap)

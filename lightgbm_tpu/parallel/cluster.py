@@ -301,11 +301,23 @@ def initialize_from_config(config) -> bool:
     with _lock:
         _state.update(initialized=True, world=world_n, rank=rank_n,
                       coordinator=coord)
+    _set_identity(rank_n, world_n)
+    from ..obs import clusterobs
+    clusterobs.configure_from_config(config)
     _start_heartbeat()
     log.info("cluster up: rank %d/%d, coordinator %s, %d global / %d "
              "local device(s)", rank_n, world_n, coord,
              jax.device_count(), jax.local_device_count())
     return True
+
+
+def _set_identity(rank_n: int, world_n: int) -> None:
+    """Propagate the resolved topology into the process identity
+    record (obs/identity.py — every metrics snapshot / trace event /
+    flight bundle stamps it) and the log prefix rank tag."""
+    from ..obs import identity
+    identity.set_topology(rank_n, world_n)
+    log.set_rank_tag(identity.log_tag())
 
 
 def _adopt_live_topology() -> None:
@@ -316,6 +328,7 @@ def _adopt_live_topology() -> None:
             if _state["world"] == 1:
                 _state.update(world=jax.process_count(),
                               rank=jax.process_index())
+        _set_identity(_state["rank"], _state["world"])
         _start_heartbeat()
 
 
@@ -335,6 +348,7 @@ def _start_heartbeat() -> None:
     stop = threading.Event()
 
     def beat():
+        from ..obs import clusterobs
         seq = 0
         while not stop.is_set():
             try:
@@ -347,6 +361,16 @@ def _start_heartbeat() -> None:
                 # coordinator gone: nothing to publish to — the main
                 # thread's own collectives will surface the failure
                 return
+            # metrics digest rides the same clock at a slower multiple
+            # (obs/clusterobs.py): ~kilobytes every DIGEST_EVERY_BEATS
+            # beats against the heartbeat's bytes every beat. A digest
+            # failure is NOT liveness-fatal: keep beating.
+            if (seq % clusterobs.DIGEST_EVERY_BEATS == 0
+                    and clusterobs.enabled()):
+                try:
+                    clusterobs.publish_digest(client, rank())
+                except Exception:       # noqa: BLE001 — telemetry
+                    pass                # must never kill the heartbeat
             seq += 1
             stop.wait(HEARTBEAT_S)
 

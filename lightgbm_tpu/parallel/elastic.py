@@ -124,6 +124,12 @@ def run_worker(spec: Dict) -> Dict:
     if spec.get("checkpoint_dir"):
         params.setdefault("tpu_checkpoint_dir", spec["checkpoint_dir"])
         params.setdefault("tpu_checkpoint_freq", 1)
+    out_dir = os.path.dirname(str(spec.get("out", "") or ""))
+    if out_dir:
+        # every rank's flight recorder dumps into the SHARED workdir
+        # so the survivor's incident sweep (obs/incident.py) reaches
+        # the victim's pre-kill bundle too
+        params.setdefault("tpu_flight_dir", out_dir)
     cfg = Config().set(params)
     multi = cluster.initialize_from_config(cfg)
     t0 = time.monotonic()
@@ -204,12 +210,36 @@ def run_worker(spec: Dict) -> Dict:
         # report, then a prompt controlled exit (jax's own shutdown
         # barrier would abort the process — see cluster.shutdown)
         log.warning("%s", err)
+        # this rank's own black box first (the survivor's state AT the
+        # loss), then the cross-rank incident: sweep every reachable
+        # flight bundle — the victim's pre-kill dump landed in the
+        # shared tpu_flight_dir before its SIGKILL — plus the final KV
+        # digest snapshot into ONE document (obs/incident.py)
+        incident_path = None
+        try:
+            from ..obs import flight as obs_flight
+            from ..obs import incident as obs_incident
+            obs_flight.trigger(
+                "peer_lost",
+                {"dead_ranks": list(err.ranks),
+                 "error": str(err)[:400],
+                 "iteration": int(g.current_iteration)}, force=True)
+            sweep_dir = str(cfg.tpu_flight_dir or "") or (
+                os.path.dirname(my_out) if my_out else "")
+            if sweep_dir:
+                incident_path = obs_incident.write_incident(
+                    "peer_lost", sweep_dir, dead_ranks=err.ranks,
+                    context={"error": str(err)[:400],
+                             "iteration": int(g.current_iteration)})
+        except Exception:       # noqa: BLE001 — the postmortem must
+            pass                # never block the controlled exit
         if my_out:
             _write_json(my_out, {
                 "rank": cluster.rank(), "world": cluster.world(),
                 "peer_lost": True, "dead_ranks": err.ranks,
                 "error": str(err),
                 "iterations": int(g.current_iteration),
+                "incident": incident_path,
                 "wall_s": round(time.monotonic() - t0, 3)})
         os._exit(cluster.EXIT_PEER_LOST)
 
@@ -274,8 +304,27 @@ def run_worker(spec: Dict) -> Dict:
             _write_json(out_base, result)
     if my_out:
         _write_json(my_out, result)
+    if multi:
+        # deterministic end-of-run rollup: push THIS rank's final
+        # digest now (the heartbeat ride-along may not have fired
+        # since the last iteration), and after the barrier below
+        # proves every rank published, rank 0 merges and writes the
+        # cluster/* rollups into its export files — the summed
+        # cluster counters equal the per-rank digests by construction
+        from ..obs import clusterobs
+        clusterobs.publish_now()
     # every rank's files are on disk before anyone tears down
     cluster.barrier("elastic-train-done")
+    if multi and cluster.rank() == 0:
+        from ..obs import clusterobs
+        from ..obs import export as obs_export
+        try:
+            clusterobs.refresh_from_kv()
+            exp = obs_export.global_exporter()
+            if exp is not None:
+                exp._write_once()
+        except Exception as e:          # noqa: BLE001 — telemetry
+            log.debug("final cluster rollup skipped: %s", e)
     cluster.shutdown()
     return result
 
@@ -452,6 +501,12 @@ def run_drill(workdir: str, *, n: int = DRILL_N, iterations: int = 10,
     dir_a = os.path.join(workdir, "a_uninterrupted")
     os.makedirs(dir_a, exist_ok=True)
     spec_a = dict(base)
+    # phase A also exercises the cluster-scope rollup path: rank 0's
+    # exporter merges both ranks' KV digests into cluster/* series and
+    # the artifact carries the final rollup (obs/clusterobs.py)
+    spec_a["params"] = {**base["params"],
+                        "tpu_metrics_export":
+                            os.path.join(dir_a, "metrics")}
     spec_a.update(out=os.path.join(dir_a, "result.json"),
                   model_out=os.path.join(dir_a, "model.txt"),
                   checkpoint_dir=os.path.join(dir_a, "ckpt"))
@@ -495,6 +550,22 @@ def run_drill(workdir: str, *, n: int = DRILL_N, iterations: int = 10,
     if not surv.get("peer_lost") or 1 not in surv.get("dead_ranks", []):
         raise RuntimeError(f"drill phase B: survivor report does not "
                            f"name rank 1: {surv}")
+    # the distributed incident: the survivor assembled one on its way
+    # out (every rank's flight recorder dumped into the shared dir_b);
+    # re-sweep now that BOTH processes have exited — the victim's
+    # pre-kill bundle can hit the disk after the survivor's sweep
+    from ..obs import incident as obs_incident
+    incident_path = surv.get("incident") or os.path.join(
+        dir_b, "incident_peer_lost.json")
+    inc_doc = None
+    if os.path.exists(incident_path):
+        inc_doc = obs_incident.resweep(incident_path, dir_b)
+    if inc_doc is None:
+        incident_path = obs_incident.write_incident(
+            "drill_peer_lost", dir_b, dead_ranks=[1],
+            context={"kill_iteration": kill_at})
+        inc_doc = (obs_incident.load_incident(incident_path)
+                   if incident_path else None)
 
     # phase C: resume the survivor onto a ONE-process mesh
     dir_c = os.path.join(workdir, "c_resumed")
@@ -526,6 +597,9 @@ def run_drill(workdir: str, *, n: int = DRILL_N, iterations: int = 10,
     parity = _strip_volatile(model_a) == _strip_volatile(model_c)
 
     return {
+        "cluster_obs": _cluster_obs_section(
+            os.path.join(dir_a, "metrics.r0.jsonl"), world=2),
+        "incident": _incident_section(incident_path, inc_doc),
         "schema": "lightgbm-tpu/multichip-drill",
         "version": 1,
         "drill": "elastic_resume",
@@ -549,6 +623,62 @@ def run_drill(workdir: str, *, n: int = DRILL_N, iterations: int = 10,
         "wall_s": {"uninterrupted": round(wall_a, 2),
                    "killed": round(wall_b, 2),
                    "resumed": round(wall_c, 2)},
+    }
+
+
+def _cluster_obs_section(jsonl_path: str, world: int) -> Optional[Dict]:
+    """The final cluster/* rollup out of rank 0's JSONL export, shaped
+    for the MULTICHIP artifact (tools/check_bench_regression.py
+    validates the shape; it never perf-gates these numbers). None when
+    the export is absent/unparseable — a missing rollup is a note, not
+    a drill failure."""
+    last = None
+    try:
+        with open(jsonl_path) as fh:
+            for ln in fh:
+                ln = ln.strip()
+                if ln:
+                    last = json.loads(ln)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(last, dict):
+        return None
+    counters = last.get("counters") or {}
+    gauges = last.get("gauges") or {}
+    if not any(k.startswith("cluster/") for k in counters):
+        return None
+    return {
+        "export": jsonl_path,
+        "world": gauges.get("cluster/world"),
+        "ranks_reporting": gauges.get("cluster/ranks_reporting"),
+        "counters": {k: v for k, v in counters.items()
+                     if k.startswith("cluster/")},
+        "per_rank_iter_wall_mean_s": {
+            k.rsplit("/r", 1)[1]: v for k, v in gauges.items()
+            if k.startswith("cluster/iter_wall_mean_s/r")},
+        "straggler": {
+            "psum_stall_max_rank":
+                gauges.get("cluster/psum_stall_max_rank"),
+            "slowest_iter_rank":
+                gauges.get("cluster/slowest_iter_rank")},
+    }
+
+
+def _incident_section(path: Optional[str],
+                      doc: Optional[Dict]) -> Optional[Dict]:
+    """The incident bundle summarized for the MULTICHIP artifact —
+    the full document stays on disk; the artifact carries what the
+    gate checks (who died, whose evidence made it in)."""
+    if not path or not isinstance(doc, dict):
+        return None
+    return {
+        "path": path,
+        "schema": doc.get("schema"),
+        "version": doc.get("version"),
+        "dead_ranks": doc.get("dead_ranks", []),
+        "ranks_with_dumps": doc.get("ranks_with_dumps", []),
+        "digest_ranks": sorted(int(k) for k in
+                               (doc.get("digests") or {})),
     }
 
 
@@ -624,6 +754,14 @@ def train_autoscale(workdir: str, *, n: int = DRILL_N, f: int = DRILL_F,
                 if done > 0:
                     reshards += 1
                     obs.counter("elastic/reshard_total").add(1)
+                    # instant on the trace timeline (the restore path
+                    # bumps the identity incarnation when it actually
+                    # re-shards the score buffers, utils/checkpoint.py)
+                    from ..obs import trace as obs_trace
+                    obs_trace.instant(
+                        "elastic/reshard", cat="cluster",
+                        args={"from_world": world, "to_world": target,
+                              "iteration": done})
                     log.info("elastic autoscale: re-sharding world "
                              "%d -> %d at iteration %d (resume from "
                              "%s)", world, target, done, ckpt_dir)

@@ -84,6 +84,7 @@ VOLATILE_KNOBS = frozenset({
     "tpu_resume_from", "tpu_faults", "tpu_fault_seed",
     "tpu_retry_attempts",
     "tpu_reqlog", "tpu_reqlog_sample", "tpu_slo", "tpu_flight_buffer",
+    "tpu_flight_dir", "tpu_cluster_obs",
     # cluster topology (parallel/cluster.py): ELASTIC resume is the
     # whole point — a checkpoint written by a 4-process run must
     # restore under 2 processes (or 1) without a fingerprint refusal,
@@ -386,8 +387,10 @@ class AsyncCheckpointWriter:
                 self._busy = True
                 obs.gauge("ckpt/queue_depth").set(len(self._jobs))
             t0 = time.monotonic()
+            committed = False
             try:
                 _commit_bundle(job[0], job[1], job[2], job[3], job[4])
+                committed = True
             except Exception as e:       # same downgrade as the sync
                 # path's caller: warn + count, never stop training
                 obs.counter("checkpoint/write_failures").add(1)
@@ -398,6 +401,16 @@ class AsyncCheckpointWriter:
             finally:
                 dt = time.monotonic() - t0
                 obs.counter("ckpt/hidden_s").add(dt)
+                if committed:
+                    # instant on the trace timeline: the off-thread
+                    # commit is visible WHERE it landed relative to
+                    # the training iterations it hid behind
+                    from ..obs import trace as obs_trace
+                    obs_trace.instant(
+                        "ckpt/async_commit", cat="ckpt",
+                        args={"path": job[1],
+                              "iteration": job[3].get("iteration"),
+                              "write_s": round(dt, 6)})
                 with self._lock:
                     self._busy = False
                     self._write_s += dt
@@ -539,6 +552,11 @@ def save_checkpoint(booster, directory: str, keep: int = 3,
         "scores_file": os.path.basename(scores_path(path)),
         "model": booster.model_to_string(),
     }
+    # who wrote the bundle (obs/identity.py) — postmortem provenance,
+    # NOT part of the resume fingerprint: config_fingerprint hashes the
+    # config, never this bundle, so a rank-0 write restores anywhere
+    from ..obs import identity
+    bundle["identity"] = identity.identity()
     if writer is not None:
         writer.submit(directory, path, arrays, bundle, keep)
         return path
@@ -688,6 +706,17 @@ def restore(booster, bundle: dict) -> int:
             fresh = np.array(cluster.fetch(booster._scores))
             fresh[:, :n_real] = scores[:, :n_real]
             scores = fresh
+            # an elastic re-shard starts a new INCARNATION of this
+            # process's lifetime (obs/identity.py): every telemetry
+            # record after this instant is distinguishable from the
+            # pre-reshard stream it would otherwise blend into
+            from ..obs import identity, trace as obs_trace
+            inc = identity.bump_incarnation(
+                f"elastic re-shard world {old_world} -> {new_world}")
+            obs_trace.instant(
+                "elastic/reshard", cat="cluster",
+                args={"from_world": old_world, "to_world": new_world,
+                      "incarnation": inc})
             log.info("elastic resume: re-sharded checkpoint scores "
                      "from world=%s (%s devices, width %d) onto "
                      "world=%d (%d devices, width %d) — %d real rows "

@@ -41,6 +41,10 @@ _run_context = None
 # output — the flight recorder's log ring (obs/flight.py). A sink must
 # be cheap and never raise.
 _sinks: list = []
+# rank tag ("r1") set by the cluster layer under world>1 so interleaved
+# multi-process stderr is attributable without grepping pids; empty
+# single-process (the prefix stays byte-identical)
+_rank_tag = ""
 
 
 def set_level(level: LogLevel | int) -> None:
@@ -68,6 +72,21 @@ def set_run_context(provider) -> None:
         _run_context = provider
 
 
+def set_rank_tag(tag: str) -> None:
+    """Install (or clear, with "") the rank tag the prefix carries —
+    parallel/cluster.py sets it at bootstrap/adoption under world>1;
+    every line then reads ``[r1 t+12.3s it=140]`` (or ``[r1]`` outside
+    a run context)."""
+    global _rank_tag
+    with _lock:
+        _rank_tag = str(tag or "")
+
+
+def rank_tag() -> str:
+    with _lock:
+        return _rank_tag
+
+
 def add_sink(fn) -> None:
     """Register a tee sink fed every emitted line (idempotent)."""
     with _lock:
@@ -84,9 +103,11 @@ def remove_sink(fn) -> None:
 def _write(level: LogLevel, tag: str, msg: str) -> None:
     with _lock:
         lvl, cb, ctx = _current_level, _callback, _run_context
+        rtag = _rank_tag
     if level > lvl:
         return
     prefix = ""
+    parts = [rtag] if rtag else []
     if ctx is not None:
         try:
             rc = ctx()
@@ -94,8 +115,10 @@ def _write(level: LogLevel, tag: str, msg: str) -> None:
             rc = None                   # decoration, never a failure
         if rc is not None:
             elapsed, it = rc
-            prefix = (f"[t+{elapsed:.1f}s"
-                      + (f" it={it}" if it is not None else "") + "] ")
+            parts.append(f"t+{elapsed:.1f}s"
+                         + (f" it={it}" if it is not None else ""))
+    if parts:
+        prefix = "[" + " ".join(parts) + "] "
     line = f"[LightGBM-TPU] [{tag}] {prefix}{msg}"
     for sink in tuple(_sinks):
         try:

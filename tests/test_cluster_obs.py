@@ -1,0 +1,529 @@
+"""Cluster-scope observability (obs/identity.py, obs/clusterobs.py,
+obs/incident.py — Design.md §6e): rank-aware telemetry, the per-rank
+metrics digest -> rank-0 ``cluster/*`` rollup pipeline, distributed
+incident bundles, and the cross-rank merged timeline.
+
+Unit layer (pytest -m obs): identity/path policy, digest wire
+round-trip, rollup merge correctness — summed counters and merged
+histograms whose quantiles track numpy over the UNION of per-rank
+samples — the KV key discipline over a fake client, incident
+sweep/build/resweep, the trace_summary clock-alignment merge, and the
+drill-artifact section validators.
+
+Process layer (pytest -m multihost): 2 REAL jax.distributed processes
+export rank-suffixed artifacts with no path collision, rank 0's export
+carries the ``cluster/*`` rollup whose merged iteration histogram
+counts every rank's iterations, the per-rank trace files merge onto
+one timeline, and a SIGKILL drill leaves ONE incident bundle naming
+the dead rank with both ranks' flight dumps embedded.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.obs import clusterobs, identity, incident
+from lightgbm_tpu.obs.registry import MetricsRegistry
+from lightgbm_tpu.utils import log
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _restore_identity():
+    """Every test leaves the process single-rank again (identity is a
+    process-global; a leaked world>1 would rank-suffix every later
+    test's artifact paths)."""
+    yield
+    identity.set_topology(0, 1)
+    log.set_rank_tag("")
+    clusterobs.reset()
+
+
+# ---------------------------------------------------------------------------
+# identity + path policy
+# ---------------------------------------------------------------------------
+
+def test_rank_suffixed_single_process_is_byte_identical():
+    assert identity.rank_suffixed("metrics.prom") == "metrics.prom"
+    assert identity.rank_suffixed("") == ""
+    assert identity.log_tag() == ""
+    assert not identity.is_multiprocess()
+
+
+def test_rank_suffixed_inserts_before_extension():
+    identity.set_topology(1, 2)
+    assert identity.rank_suffixed("metrics.prom") == "metrics.r1.prom"
+    assert identity.rank_suffixed("/a/b/trace.json") == \
+        "/a/b/trace.r1.json"
+    assert identity.rank_suffixed("report") == "report.r1"
+    # explicit rank overrides the ambient one (the exporter suffixes
+    # its base once, before splitting into .prom/.jsonl)
+    assert identity.rank_suffixed("m.jsonl", rank_n=0) == "m.r0.jsonl"
+    assert identity.log_tag() == "r1"
+
+
+def test_topology_and_incarnation_stamp_every_surface():
+    identity.set_topology(1, 4)
+    ident = identity.identity()
+    assert ident["machine_rank"] == 1 and ident["world"] == 4
+    before = identity.incarnation()
+    new = identity.bump_incarnation("unit re-shard")
+    assert new == before + 1
+    assert identity.identity()["incarnation"] == new
+    # the digest built AFTER the bump carries the new incarnation
+    d = clusterobs.build_digest(MetricsRegistry())
+    assert d["identity"] == identity.identity()
+
+
+def test_log_prefix_carries_rank_tag(capsys):
+    prev = log.get_level()
+    log.set_level(log.LogLevel.INFO)
+    try:
+        log.set_rank_tag("r1")
+        log.info("cluster hello")
+        err = capsys.readouterr().err
+        assert "[r1]" in err and "cluster hello" in err
+        log.set_rank_tag("")
+        log.info("solo hello")
+        err = capsys.readouterr().err
+        assert "[r1]" not in err and "solo hello" in err
+    finally:
+        log.set_level(prev)
+
+
+def test_trace_events_stamp_rank_only_multiprocess():
+    from lightgbm_tpu.obs import trace as obs_trace
+    ev = {"ph": "i", "name": "x", "ts": 1.0, "args": {}}
+    obs_trace._stamp_rank(ev)
+    assert "rank" not in (ev.get("args") or {})      # world == 1
+    identity.set_topology(1, 2)
+    obs_trace._stamp_rank(ev)
+    assert ev["args"]["rank"] == 1
+
+
+# ---------------------------------------------------------------------------
+# digest wire + rollup merge
+# ---------------------------------------------------------------------------
+
+_BUCKETS = tuple(round(0.05 * i, 2) for i in range(1, 41))  # 0.05..2.0
+
+
+def _digest_for_rank(rank_n, samples, stall, extra=10.0):
+    reg = MetricsRegistry()
+    reg.counter("comm/psum_stall_s").add(stall)
+    reg.counter("train/trees_total").add(extra)
+    reg.gauge("ckpt/queue_depth").set(rank_n)
+    h = reg.histogram("train/iteration_s", _BUCKETS)
+    for s in samples:
+        h.observe(float(s))
+    d = clusterobs.build_digest(reg)
+    d["identity"] = {"machine_rank": rank_n, "world": 2,
+                     "incarnation": 0}
+    return d
+
+
+def test_digest_build_and_wire_roundtrip():
+    d = _digest_for_rank(0, [0.1, 0.2], stall=1.5)
+    assert d["schema"] == clusterobs.DIGEST_SCHEMA
+    assert d["version"] == clusterobs.DIGEST_VERSION
+    assert d["counters"]["comm/psum_stall_s"] == 1.5
+    assert d["hists"]["train/iteration_s"]["c"][1] == 1   # 0.1 bucket
+    back = clusterobs.digest_from_wire(clusterobs.digest_to_wire(d))
+    assert back == d
+    # malformed wire never raises, it reads as "no digest"
+    assert clusterobs.digest_from_wire("{truncated") is None
+    assert clusterobs.digest_from_wire(json.dumps({"schema": "x"})) \
+        is None
+    assert clusterobs.digest_from_wire(json.dumps(
+        {"schema": clusterobs.DIGEST_SCHEMA, "version": 99})) is None
+
+
+def test_merge_sums_counters_and_quantiles_track_union():
+    """The tentpole invariant: ``cluster/<h>`` quantiles interpolate
+    over the TRUE union distribution (elementwise bucket-count sums),
+    not an average of per-rank quantiles."""
+    r = np.random.default_rng(7)
+    s0 = r.uniform(0.05, 0.9, 400)
+    s1 = r.uniform(0.6, 1.8, 600)          # rank 1 is the straggler
+    digests = {0: _digest_for_rank(0, s0, stall=1.5),
+               1: _digest_for_rank(1, s1, stall=4.0)}
+    agg = clusterobs.merge_digests(digests, world_n=2)
+    snap = agg.snapshot()
+    assert snap["gauges"]["cluster/world"] == 2
+    assert snap["gauges"]["cluster/ranks_reporting"] == 2
+    assert snap["counters"]["cluster/comm/psum_stall_s"] == 5.5
+    assert snap["counters"]["cluster/train/trees_total"] == 20.0
+    h = agg.histogram("cluster/train/iteration_s", _BUCKETS)
+    union = np.concatenate([s0, s1])
+    assert h.snapshot()["count"] == len(union)
+    assert h.snapshot()["sum"] == pytest.approx(union.sum(), rel=1e-6)
+    for q in (0.5, 0.9, 0.99):
+        est = h.percentile(q)
+        true = float(np.quantile(union, q))
+        # within one 0.05 bucket of numpy over the union
+        assert abs(est - true) <= 0.051, (q, est, true)
+    # straggler attribution names rank 1 on both families
+    assert snap["gauges"]["cluster/psum_stall_max_rank"] == 1
+    assert snap["gauges"]["cluster/slowest_iter_rank"] == 1
+    assert snap["gauges"]["cluster/psum_stall_s/r0"] == 1.5
+    assert snap["gauges"]["cluster/psum_stall_s/r1"] == 4.0
+    m0 = snap["gauges"]["cluster/iter_wall_mean_s/r0"]
+    m1 = snap["gauges"]["cluster/iter_wall_mean_s/r1"]
+    assert m0 == pytest.approx(s0.mean(), rel=1e-6)
+    assert m1 == pytest.approx(s1.mean(), rel=1e-6)
+
+
+def test_merge_skips_mismatched_bucket_bounds():
+    d0 = _digest_for_rank(0, [0.1, 0.2, 0.3], stall=0.0)
+    d1 = _digest_for_rank(1, [0.4], stall=0.0)
+    d1["hists"]["train/iteration_s"]["b"] = [1.0, 2.0]   # version skew
+    d1["hists"]["train/iteration_s"]["c"] = [1, 0, 0]
+    agg = clusterobs.merge_digests({0: d0, 1: d1}, world_n=2)
+    h = agg.histogram("cluster/train/iteration_s", _BUCKETS)
+    assert h.snapshot()["count"] == 3      # rank 1's skewed hist out
+    assert clusterobs.missing_ranks({0: d0}, 3) == [1, 2]
+
+
+class _FakeKV:
+    """The coordination-service KV surface the digest publisher uses
+    (jax coordination client: key_value_set/delete/dir_get)."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_set(self, k, v):
+        self.kv[k] = v
+
+    def key_value_delete(self, k):
+        self.kv.pop(k, None)
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in sorted(self.kv.items())
+                if k.startswith(prefix)]
+
+
+def test_publish_read_kv_roundtrip_keeps_one_digest_per_rank():
+    clusterobs.reset()
+    client = _FakeKV()
+    assert clusterobs.publish_digest(client, 0)
+    assert clusterobs.publish_digest(client, 0)
+    # seq-in-key discipline: the previous seq is deleted, one digest
+    # per rank remains in the directory
+    keys = [k for k in client.kv if k.startswith("lgbm_tpu/obs/0/")]
+    assert keys == ["lgbm_tpu/obs/0/1"]
+    # a second rank + one junk value (truncated write) alongside
+    d1 = _digest_for_rank(1, [0.2], stall=0.5)
+    client.key_value_set("lgbm_tpu/obs/1/7",
+                         clusterobs.digest_to_wire(d1))
+    client.key_value_set("lgbm_tpu/obs/2/0", "{torn")
+    got = clusterobs.read_digests(client)
+    assert sorted(got) == [0, 1]
+    assert got[1] == d1
+    assert got[0]["schema"] == clusterobs.DIGEST_SCHEMA
+
+
+def test_enablement_knob_off_stops_publish():
+    clusterobs.configure_from_config({"tpu_cluster_obs": 0})
+    try:
+        assert not clusterobs.enabled()
+        assert clusterobs.publish_now() is False
+        clusterobs.configure_from_config({"tpu_cluster_obs": -1})
+        assert clusterobs.enabled()
+        clusterobs.configure_from_config({"tpu_cluster_obs": 7})
+        assert clusterobs.enabled()            # garbage reads as auto
+    finally:
+        clusterobs.configure_from_config({"tpu_cluster_obs": -1})
+
+
+# ---------------------------------------------------------------------------
+# incident bundles
+# ---------------------------------------------------------------------------
+
+def _flight_doc(rank_n, created, spans=()):
+    return {"schema": "lightgbm-tpu/flight", "version": 1,
+            "created_unix": created, "pid": 100 + rank_n,
+            "identity": {"machine_rank": rank_n, "world": 2,
+                         "incarnation": 0},
+            "reason": "unit", "context": {}, "triggers": [],
+            "spans": list(spans), "log_lines": [], "reqlog": [],
+            "metrics": {}, "slo": None}
+
+
+def _write_flight(directory, name, doc):
+    with open(os.path.join(directory, name), "w") as fh:
+        json.dump(doc, fh)
+
+
+def test_incident_sweep_build_resweep(tmp_path):
+    d = str(tmp_path)
+    _write_flight(d, "flight_r0_p100_001_a.json", _flight_doc(0, 10.0))
+    _write_flight(d, "flight_r0_p100_002_b.json", _flight_doc(0, 12.0))
+    _write_flight(d, "flight_r1_p101_001_a.json", _flight_doc(1, 11.0))
+    # a legacy pre-rank-tag dump attributes to rank 0 by filename rule
+    legacy = _flight_doc(0, 9.0)
+    legacy.pop("identity")
+    _write_flight(d, "flight_p77_001_old.json", legacy)
+    with open(os.path.join(d, "flight_r9_p9_001_bad.json"), "w") as fh:
+        fh.write("{torn write")               # skipped, never raises
+    swept = incident.sweep_flight_dumps(d)
+    assert sorted(swept) == [0, 1]
+    assert [b["bundle"]["created_unix"] for b in swept[0]] == \
+        [9.0, 10.0, 12.0]                      # oldest first
+
+    # the final KV digest snapshot rides into the bundle
+    with clusterobs._lock:
+        clusterobs._last_digests.update(
+            {0: _digest_for_rank(0, [0.1], stall=0.0),
+             1: _digest_for_rank(1, [0.2], stall=0.0)})
+    path = incident.write_incident("peer_lost", d, dead_ranks=[1],
+                                   context={"kill_iteration": 3})
+    assert path and os.path.basename(path) == "incident_peer_lost.json"
+    doc = incident.load_incident(path)
+    assert doc["schema"] == incident.INCIDENT_SCHEMA
+    assert doc["version"] == incident.INCIDENT_VERSION
+    assert doc["dead_ranks"] == [1]
+    assert doc["ranks_with_dumps"] == [0, 1]
+    assert len(doc["ranks"]["0"]) == 3 and len(doc["ranks"]["1"]) == 1
+    assert sorted(doc["digests"]) == ["0", "1"]
+
+    # the victim's late dump flushes AFTER assembly: resweep picks it
+    # up while keeping the (now unreachable) KV digests
+    _write_flight(d, "flight_r1_p101_002_late.json",
+                  _flight_doc(1, 13.0))
+    doc2 = incident.resweep(path, d)
+    assert len(doc2["ranks"]["1"]) == 2
+    assert sorted(doc2["digests"]) == ["0", "1"]
+    assert incident.load_incident(path)["ranks_with_dumps"] == [0, 1]
+
+    # versioned-artifact discipline: a foreign schema is refused
+    with open(os.path.join(d, "not_incident.json"), "w") as fh:
+        json.dump({"schema": "x"}, fh)
+    with pytest.raises(ValueError, match="not an incident"):
+        incident.load_incident(os.path.join(d, "not_incident.json"))
+
+
+# ---------------------------------------------------------------------------
+# cross-rank merged timeline (tools/trace_summary.py --merge)
+# ---------------------------------------------------------------------------
+
+def _trace_doc(rank_n, started_unix):
+    return {"traceEvents": [
+        {"ph": "X", "name": "train/iter", "ts": 1000.0, "dur": 500.0,
+         "pid": 1, "tid": 1, "args": {}},
+        {"ph": "i", "name": "mark/it", "ts": 2000.0, "pid": 1,
+         "tid": 1, "args": {"it": 1}},
+    ], "otherData": {"started_unix": started_unix,
+                     "identity": {"machine_rank": rank_n, "world": 2,
+                                  "incarnation": 0}}}
+
+
+def test_merge_aligns_clocks_and_stamps_ranks(tmp_path):
+    import trace_summary as ts
+    p0 = str(tmp_path / "trace.r0.json")
+    p1 = str(tmp_path / "trace.r1.json")
+    with open(p0, "w") as fh:
+        json.dump(_trace_doc(0, 100.0), fh)
+    with open(p1, "w") as fh:
+        json.dump(_trace_doc(1, 102.0), fh)    # started 2s later
+    loaded = []
+    for p in (p0, p1):
+        kind, doc = ts.load_artifact(p)
+        loaded.append((p, kind, doc))
+    merged = ts.merge_entries(loaded)
+    assert merged["meta"]["t0_unix"] == 100.0
+    ranks = {(ev.get("args") or {}).get("rank")
+             for ev in merged["events"]}
+    assert ranks == {0, 1}
+    by_rank_instant = {
+        (ev["args"]["rank"]): ev["ts"] for ev in merged["events"]
+        if ev["ph"] == "i"}
+    # rank 1's events shift by the 2s anchor gap onto rank 0's clock
+    assert by_rank_instant[1] - by_rank_instant[0] == \
+        pytest.approx(2e6)
+    assert merged["events"] == sorted(
+        merged["events"], key=lambda e: e["ts"])
+    out = ts.render_merged(merged)
+    assert "rank" in out and "train/iter" in out
+
+
+def test_merge_expands_incident_bundles(tmp_path):
+    import trace_summary as ts
+    d = str(tmp_path)
+    spans0 = [{"ph": "X", "name": "iter", "ts": 500.0, "dur": 100.0,
+               "pid": 100, "tid": 1, "args": {}}]
+    spans1 = [{"ph": "X", "name": "iter", "ts": 600.0, "dur": 150.0,
+               "pid": 101, "tid": 1, "args": {}}]
+    _write_flight(d, "flight_r0_p100_001_a.json",
+                  _flight_doc(0, 50.0, spans0))
+    _write_flight(d, "flight_r1_p101_001_a.json",
+                  _flight_doc(1, 50.1, spans1))
+    path = incident.write_incident("drill", d, dead_ranks=[1])
+    kind, doc = ts.load_artifact(path)
+    assert kind == "incident"
+    assert doc["meta"]["dead_ranks"] == [1]
+    assert len(doc["bundles"]) == 2
+    merged = ts.merge_entries([(path, kind, doc)])
+    ranks = {(ev.get("args") or {}).get("rank")
+             for ev in merged["events"]}
+    assert ranks == {0, 1}
+    assert len(merged["meta"]["sources"]) == 2
+    out = ts.render_merged(merged)
+    assert "iter" in out
+
+
+# ---------------------------------------------------------------------------
+# drill-artifact section validators (tools/check_bench_regression.py)
+# ---------------------------------------------------------------------------
+
+def test_artifact_validators_accept_and_note():
+    import check_bench_regression as cbr
+    schema, notes = [], []
+    cbr._check_cluster_obs({"cluster_obs": {
+        "export": "m.r0.jsonl", "world": 2, "ranks_reporting": 2,
+        "counters": {"cluster/train/trees_total": 20}}}, schema, notes)
+    cbr._check_incident({"incident": {
+        "path": "i.json", "schema": "lightgbm-tpu/incident",
+        "version": 1, "dead_ranks": [1], "ranks_with_dumps": [0, 1],
+        "digest_ranks": [0, 1]}}, schema, notes)
+    assert schema == []
+    assert any("2/2 ranks" in n for n in notes)
+    assert any("dead_ranks=[1]" in n for n in notes)
+
+    # absent sections are notes (evidence missing), never gates
+    schema, notes = [], []
+    cbr._check_cluster_obs({}, schema, notes)
+    cbr._check_incident({}, schema, notes)
+    assert schema == [] and len(notes) == 2
+
+    # malformed shapes ARE schema problems; a dead rank with no
+    # recovered dump is a note
+    schema, notes = [], []
+    cbr._check_cluster_obs({"cluster_obs": {"counters": {},
+                                            "world": "x"}},
+                           schema, notes)
+    cbr._check_incident({"incident": {
+        "schema": "lightgbm-tpu/incident", "version": 1,
+        "dead_ranks": [1], "ranks_with_dumps": [0]}}, schema, notes)
+    assert any("cluster/*-keyed" in s for s in schema)
+    assert any("numeric" in s for s in schema)
+    assert any("no flight dump recovered" in n for n in notes)
+
+
+# ---------------------------------------------------------------------------
+# real processes: rank-suffixed exports, cluster rollup, incident drill
+# ---------------------------------------------------------------------------
+
+_SKIP_SPAWN = bool(os.environ.get("LGBM_TPU_SKIP_MULTIHOST"))
+
+
+@pytest.mark.multihost
+@pytest.mark.skipif(_SKIP_SPAWN, reason="LGBM_TPU_SKIP_MULTIHOST set")
+def test_two_process_rollup_and_rank_suffixed_artifacts(tmp_path):
+    """2 REAL ranks: export/trace paths rank-suffix (no collision),
+    rank 0's export folds the ``cluster/*`` rollup built from both
+    ranks' digests, rank 1 publishes but never merges, and the two
+    trace files merge onto one aligned timeline."""
+    from lightgbm_tpu.parallel import elastic
+    import trace_summary as ts
+    iters = 3
+    elastic.run_two_process(
+        str(tmp_path), n=768, iterations=iters,
+        extra_params={"tpu_metrics_export": str(tmp_path / "metrics"),
+                      "tpu_trace": str(tmp_path / "trace.json")})
+    # satellite 1: the PR-6 collision fix — one file per rank, no
+    # unsuffixed path ever written
+    for name in ("metrics.r0.jsonl", "metrics.r1.jsonl",
+                 "metrics.r0.prom", "metrics.r1.prom",
+                 "trace.r0.json", "trace.r1.json"):
+        assert (tmp_path / name).exists(), name
+    assert not (tmp_path / "metrics.jsonl").exists()
+    assert not (tmp_path / "trace.json").exists()
+
+    def last_snap(name):
+        lines = (tmp_path / name).read_text().strip().splitlines()
+        return json.loads(lines[-1])
+
+    snap0 = last_snap("metrics.r0.jsonl")
+    assert snap0["identity"]["machine_rank"] == 0
+    assert snap0["identity"]["world"] == 2
+    assert snap0["gauges"]["cluster/world"] == 2
+    assert snap0["gauges"]["cluster/ranks_reporting"] == 2
+    # the acceptance invariant: the merged iteration histogram counts
+    # EVERY rank's iterations — summed per-rank digests, nothing lost
+    ch = snap0["histograms"]["cluster/train/iteration_s"]
+    assert ch["count"] == 2 * iters
+    for r in (0, 1):
+        assert f"cluster/iter_wall_mean_s/r{r}" in snap0["gauges"]
+    assert snap0["gauges"]["cluster/slowest_iter_rank"] in (0, 1)
+    # rank 1 stamps identity but holds no rollup (publishers never
+    # merge); its prom export carries the identity info-gauge
+    snap1 = last_snap("metrics.r1.jsonl")
+    assert snap1["identity"]["machine_rank"] == 1
+    assert not any(k.startswith("cluster/")
+                   for k in snap1["gauges"]) and \
+        not any(k.startswith("cluster/") for k in snap1["counters"])
+    prom1 = (tmp_path / "metrics.r1.prom").read_text()
+    assert 'lgbm_tpu_identity_info{machine_rank="1"' in prom1
+
+    # per-rank traces merge: both ranks on one timeline
+    loaded = []
+    for r in (0, 1):
+        p = str(tmp_path / f"trace.r{r}.json")
+        kind, doc = ts.load_artifact(p)
+        assert kind == "trace"
+        assert doc["meta"]["identity"]["machine_rank"] == r
+        loaded.append((p, kind, doc))
+    merged = ts.merge_entries(loaded)
+    ranks = {(ev.get("args") or {}).get("rank")
+             for ev in merged["events"]}
+    assert {0, 1} <= ranks
+
+
+@pytest.mark.multihost
+@pytest.mark.skipif(_SKIP_SPAWN, reason="LGBM_TPU_SKIP_MULTIHOST set")
+def test_kill_drill_leaves_one_incident_bundle(tmp_path):
+    """SIGKILL rank 1 mid-training: the survivor assembles ONE
+    incident bundle naming the dead rank; after a post-exit resweep it
+    embeds BOTH ranks' flight dumps (the victim dumped to the shared
+    dir just before its SIGKILL)."""
+    from lightgbm_tpu.parallel import cluster, elastic
+    spec = {
+        "seed": 0, "n": 512, "f": 6,
+        "params": {"num_iterations": 6,
+                   "tpu_collective_timeout_s": 15.0},
+        "out": str(tmp_path / "result.json"),
+        "checkpoint_dir": str(tmp_path / "ckpt"),
+    }
+    spec_path = str(tmp_path / "spec.json")
+    with open(spec_path, "w") as fh:
+        json.dump(spec, fh)
+    procs = elastic.launch_workers(
+        spec_path, 2, log_dir=str(tmp_path), fault_rank=1,
+        faults="train.iter@3:kill")
+    assert procs[1].wait(timeout=240) == -9
+    assert procs[0].wait(timeout=60) == cluster.EXIT_PEER_LOST
+    surv = json.loads((tmp_path / "result.json.rank0").read_text())
+    assert surv["dead_ranks"] == [1]
+    ipath = surv.get("incident")
+    assert ipath and os.path.exists(ipath), surv
+    # flight dumps are rank-tagged into the ONE shared directory
+    names = os.listdir(tmp_path)
+    assert any(n.startswith("flight_r0_") for n in names), names
+    assert any(n.startswith("flight_r1_") for n in names), names
+    doc = incident.resweep(ipath, str(tmp_path))
+    assert doc["dead_ranks"] == [1]
+    assert doc["ranks_with_dumps"] == [0, 1]
+    victim = doc["ranks"]["1"][0]["bundle"]
+    assert victim["identity"]["machine_rank"] == 1
+    # the merged timeline renders straight off the incident bundle
+    import trace_summary as ts
+    kind, idoc = ts.load_artifact(ipath)
+    assert kind == "incident"
+    out = ts.render_merged(ts.merge_entries([(ipath, kind, idoc)]))
+    assert "rank" in out

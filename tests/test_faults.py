@@ -286,6 +286,9 @@ def test_checkpoint_elastic_reshard_same_data(tmp_path):
     ckpt.save_checkpoint(g, str(tmp_path))
     bundle_path = ckpt.list_checkpoints(str(tmp_path))[0][1]
     bundle = json.loads(open(bundle_path).read())
+    # provenance: every bundle stamps the writer's telemetry identity
+    # (never part of the resume fingerprint)
+    assert bundle["identity"]["machine_rank"] == 0
     n_real = bundle["world"]["n_real"]
     with np.load(ckpt.scores_path(bundle_path)) as z:
         saved = z["scores"]
@@ -298,8 +301,13 @@ def test_checkpoint_elastic_reshard_same_data(tmp_path):
     with open(ckpt.scores_path(bundle_path), "wb") as fh:
         np.savez_compressed(fh, scores=wider)
     fresh = build_booster(PARAMS)
+    from lightgbm_tpu.obs import identity
+    inc0 = identity.incarnation()
     it = ckpt.restore(fresh, ckpt.resolve_resume(str(tmp_path)))
     assert it == 4
+    # the re-shard starts a new incarnation of this process's
+    # telemetry identity (obs/identity.py — Design.md §6e)
+    assert identity.incarnation() == inc0 + 1
     got = np.asarray(fresh.train_scores())
     np.testing.assert_array_equal(got, saved[:, :n_real])
     # and the resumed booster keeps training

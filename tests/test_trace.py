@@ -36,9 +36,13 @@ def _valid_event(ev):
     elif ev["ph"] == "i":
         assert isinstance(ev["ts"], (int, float))
         assert ev["s"] in ("t", "p", "g")
-    else:                                # metadata: thread/process name
-        assert ev["name"] in ("thread_name", "process_name")
-        assert "name" in ev["args"]
+    else:            # metadata: thread/process name + rank labels
+        assert ev["name"] in ("thread_name", "process_name",
+                              "process_labels")
+        if ev["name"] == "process_labels":
+            assert "labels" in ev["args"]    # Chrome labels record
+        else:
+            assert "name" in ev["args"]
 
 
 def test_trace_event_schema_roundtrip(tmp_path):

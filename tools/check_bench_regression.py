@@ -721,7 +721,79 @@ def check_multichip_drill(doc: dict) -> tuple:
     if isinstance(walls, dict):
         notes.append("walls: " + ", ".join(
             f"{k}={v}s" for k, v in walls.items()))
+    _check_cluster_obs(doc, schema, notes)
+    _check_incident(doc, schema, notes)
     return schema, regressions, notes
+
+
+def _check_cluster_obs(doc: dict, schema: List[str],
+                       notes: List[str]) -> None:
+    """Shape-validate the optional ``cluster_obs`` rollup section
+    (parallel/elastic.py _cluster_obs_section — rank 0's final
+    cluster/* merge). Observability evidence, NEVER a perf gate: a
+    malformed shape is a schema problem, a missing rollup or missing
+    rank digest is a note."""
+    cobs = doc.get("cluster_obs")
+    if cobs is None:
+        notes.append("cluster_obs rollup absent (rank-0 export not "
+                     "captured)")
+        return
+    if not isinstance(cobs, dict):
+        schema.append("cluster_obs must be an object when present")
+        return
+    counters = cobs.get("counters")
+    if not (isinstance(counters, dict) and counters
+            and all(isinstance(k, str) and k.startswith("cluster/")
+                    for k in counters)):
+        schema.append("cluster_obs.counters must be a non-empty "
+                      "cluster/*-keyed map")
+    w, rr = cobs.get("world"), cobs.get("ranks_reporting")
+    if not (_num(w) and _num(rr)):
+        schema.append("cluster_obs.world/ranks_reporting must be "
+                      "numeric")
+    elif rr < w:
+        notes.append(f"cluster_obs: only {int(rr)}/{int(w)} ranks' "
+                     f"digests made the final rollup")
+    else:
+        notes.append(f"cluster_obs: {int(rr)}/{int(w)} ranks "
+                     f"reporting, {len(counters) if isinstance(counters, dict) else 0} "
+                     f"cluster counters")
+
+
+def _check_incident(doc: dict, schema: List[str],
+                    notes: List[str]) -> None:
+    """Shape-validate the optional ``incident`` summary section
+    (parallel/elastic.py _incident_section). Same discipline as
+    cluster_obs: shape errors are schema problems, absent evidence is
+    a note — never a perf regression."""
+    inc = doc.get("incident")
+    if inc is None:
+        notes.append("incident bundle absent")
+        return
+    if not isinstance(inc, dict):
+        schema.append("incident must be an object when present")
+        return
+    if (inc.get("schema") != "lightgbm-tpu/incident"
+            or inc.get("version") != 1):
+        schema.append(f"incident schema/version "
+                      f"{inc.get('schema')!r}/{inc.get('version')!r}: "
+                      f"want lightgbm-tpu/incident v1")
+    dead = inc.get("dead_ranks")
+    if not (isinstance(dead, list)
+            and all(isinstance(r, int) for r in dead)):
+        schema.append("incident.dead_ranks must be a list of ints")
+        dead = []
+    have = inc.get("ranks_with_dumps")
+    if not isinstance(have, list):
+        schema.append("incident.ranks_with_dumps must be a list")
+        have = []
+    missing = [r for r in dead if r not in have]
+    if missing:
+        notes.append(f"incident: no flight dump recovered from dead "
+                     f"rank(s) {missing}")
+    notes.append(f"incident: dead_ranks={dead}, dumps from ranks "
+                 f"{have}, digests from ranks "
+                 f"{inc.get('digest_ranks')}")
 
 
 MULTICHIP_SCALING_SCHEMA = "lightgbm-tpu/multichip-scaling"
@@ -842,6 +914,10 @@ def check_multichip_scaling(doc: dict) -> tuple:
                 f"comm {p.get('comm_bytes_per_iter')} B/iter, "
                 f"stall {p.get('psum_stall_s')} s, "
                 f"wire {p.get('wire')!r}")
+    if "cluster_obs" in doc:
+        _check_cluster_obs(doc, schema, notes)
+    if "incident" in doc:
+        _check_incident(doc, schema, notes)
     return schema, regressions, notes
 
 

@@ -17,10 +17,21 @@ the content, never the file name) and it prints
   window / rows / serve bucket / model generation where known;
 - for flight dumps: the trigger history and the dump's reason line.
 
-Standalone: ``python tools/trace_summary.py FILE [--top N]``
+**Cross-rank merge** (``--merge FILE...``): N per-rank artifacts —
+trace files, flight dumps, or ONE incident bundle
+(obs/incident.py, which embeds every rank's flight dump) — render on
+one aligned timeline. Each file's event ``ts`` values are microseconds
+since that PROCESS's tracer epoch; the merge aligns them with the
+clock-alignment rule (Design.md §6e): a trace file's wall anchor is
+``otherData.started_unix``, a flight dump's is ``created_unix -
+max(ts)/1e6``, and every event shifts by ``(anchor - min anchor)``.
+The merged span table and instant timeline carry a rank column.
+
+Standalone: ``python tools/trace_summary.py FILE [--top N]`` or
+``python tools/trace_summary.py --merge FILE [FILE...]``
 (exit 0 ok / 2 unreadable-or-unrecognized). Importable — the unit
 tests drive ``load_artifact``/``span_table``/``top_requests``/
-``render`` directly.
+``render``/``merge_entries``/``render_merged`` directly.
 """
 from __future__ import annotations
 
@@ -57,15 +68,38 @@ def load_artifact(path: str) -> Tuple[str, dict]:
                                   "meta": {
                                       "reason": doc.get("reason"),
                                       "context": doc.get("context"),
+                                      "identity": doc.get("identity"),
                                       "created_unix": doc.get(
                                           "created_unix"),
                                       "triggers": doc.get("triggers",
                                                           []),
                                       "log_lines": doc.get("log_lines",
                                                            [])}}
+            if (isinstance(doc, dict)
+                    and doc.get("schema") == "lightgbm-tpu/incident"):
+                # the distributed incident bundle embeds every rank's
+                # flight dumps; expose them for the merge path
+                bundles = []
+                for r, dumps in (doc.get("ranks") or {}).items():
+                    for d in dumps:
+                        b = d.get("bundle") or {}
+                        bundles.append((int(r), d.get("path", ""), b))
+                return "incident", {"events": [], "records": [],
+                                    "bundles": bundles,
+                                    "meta": {
+                                        "reason": doc.get("reason"),
+                                        "dead_ranks": doc.get(
+                                            "dead_ranks", []),
+                                        "identity": doc.get("identity"),
+                                        "created_unix": doc.get(
+                                            "created_unix"),
+                                        "digest_ranks": sorted(
+                                            (doc.get("digests")
+                                             or {}).keys())}}
             raise ValueError(f"{path}: JSON but neither a trace "
-                             f"(traceEvents) nor a flight dump "
-                             f"(schema=lightgbm-tpu/flight)")
+                             f"(traceEvents) nor a flight dump / "
+                             f"incident bundle (schema="
+                             f"lightgbm-tpu/flight|incident)")
         # JSONL: a request log (one wide event per line, optional
         # header record) — skip unparseable lines like lrb.py's
         # trace reader does
@@ -219,22 +253,176 @@ def render(kind: str, doc: dict, top: int = 10) -> str:
     return "\n".join(parts).rstrip() + "\n"
 
 
+# -- cross-rank merge ---------------------------------------------------------
+
+
+def _anchor_unix(kind: str, doc: dict) -> float:
+    """One artifact's wall-clock anchor: the unix time its event
+    ``ts=0`` corresponds to (the Design.md §6e clock-alignment rule).
+    Trace files record it directly (``otherData.started_unix``); a
+    flight dump's newest span landed ~at ``created_unix``, so its
+    epoch is estimated as ``created_unix - max(ts)/1e6``. 0.0 when
+    the artifact carries no wall clock (events then merge unshifted)."""
+    meta = doc.get("meta") or {}
+    if kind == "trace":
+        su = meta.get("started_unix")
+        if isinstance(su, (int, float)):
+            return float(su)
+    cu = meta.get("created_unix")
+    if isinstance(cu, (int, float)):
+        mx = max((float(e.get("ts", 0) or 0)
+                  for e in doc.get("events", [])), default=0.0)
+        return float(cu) - mx / 1e6
+    return 0.0
+
+
+def _rank_of_doc(doc: dict):
+    """The rank an artifact belongs to: its identity stamp, else the
+    first event arg that carries one, else None."""
+    ident = (doc.get("meta") or {}).get("identity")
+    if isinstance(ident, dict) and "machine_rank" in ident:
+        return ident["machine_rank"]
+    for ev in doc.get("events", []):
+        a = ev.get("args")
+        if isinstance(a, dict) and "rank" in a:
+            return a["rank"]
+    return None
+
+
+def merge_entries(loaded: List[Tuple[str, str, dict]]) -> dict:
+    """[(path, kind, doc)] -> one merged doc whose events carry
+    ``rank`` in args and ``ts`` on a COMMON timeline (µs since the
+    earliest anchor across the inputs). An incident bundle expands to
+    its embedded per-rank flight dumps before merging."""
+    flat: List[Tuple[str, str, dict, object]] = []
+    for path, kind, doc in loaded:
+        if kind == "incident":
+            for r, bpath, bundle in doc.get("bundles", []):
+                _k, bdoc = "flight", {
+                    "events": bundle.get("spans", []),
+                    "records": bundle.get("reqlog", []),
+                    "meta": {"identity": bundle.get("identity"),
+                             "created_unix": bundle.get("created_unix"),
+                             "reason": bundle.get("reason")}}
+                flat.append((bpath or f"{path}[rank {r}]", "flight",
+                             bdoc, r))
+        else:
+            flat.append((path, kind, doc, _rank_of_doc(doc)))
+    anchors = [_anchor_unix(k, d) for _p, k, d, _r in flat]
+    known = [a for a in anchors if a > 0]
+    t0 = min(known) if known else 0.0
+    events: List[dict] = []
+    records: List[dict] = []
+    sources = []
+    for (path, kind, doc, r), anchor in zip(flat, anchors):
+        shift_us = (anchor - t0) * 1e6 if anchor > 0 else 0.0
+        for ev in doc.get("events", []):
+            ev = dict(ev)
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = float(ev["ts"]) + shift_us
+            if r is not None:
+                args = dict(ev.get("args") or {})
+                args.setdefault("rank", r)
+                ev["args"] = args
+            events.append(ev)
+        records.extend(doc.get("records", []))
+        sources.append({"path": path, "kind": kind, "rank": r,
+                        "anchor_unix": round(anchor, 3) if anchor
+                        else None,
+                        "events": len(doc.get("events", []))})
+    events.sort(key=lambda e: float(e.get("ts", 0) or 0))
+    return {"events": events, "records": records,
+            "meta": {"sources": sources, "t0_unix": round(t0, 3)}}
+
+
+def render_merged(merged: dict, top: int = 10) -> str:
+    """The cross-rank rendering: sources, a span table keyed by
+    (rank, thread, span), and the aligned instant timeline."""
+    parts = []
+    parts.append(f"merged timeline over "
+                 f"{len(merged['meta']['sources'])} artifact(s), "
+                 f"t0={merged['meta']['t0_unix']}:")
+    for s in merged["meta"]["sources"]:
+        parts.append(f"  rank={s['rank']} kind={s['kind']} "
+                     f"events={s['events']} "
+                     f"anchor={s['anchor_unix']} {s['path']}")
+    parts.append("")
+    # per-(rank, thread) span table: reuse span_table per rank so the
+    # thread-name metadata of one rank never relabels another's tids
+    by_rank = {}
+    for ev in merged.get("events", []):
+        r = (ev.get("args") or {}).get("rank")
+        by_rank.setdefault(r, []).append(ev)
+    rows = []
+    for r in sorted(by_rank, key=lambda x: (x is None, x)):
+        for row in span_table(by_rank[r]):
+            row = dict(row)
+            row["rank"] = r
+            rows.append(row)
+    rows.sort(key=lambda r: -r["total_ms"])
+    if rows:
+        parts.append(f"cross-rank span table ({len(rows)} rows, "
+                     f"hottest first):")
+        parts.append(_fmt_table(rows, [
+            ("rank", "rank"), ("thread", "thread"), ("span", "span"),
+            ("count", "count"), ("total_ms", "total_ms"),
+            ("mean_ms", "mean_ms"), ("max_ms", "max_ms")]))
+        parts.append("")
+    instants = [ev for ev in merged.get("events", [])
+                if ev.get("ph") in ("i", "I")]
+    if instants:
+        parts.append(f"aligned instants ({len(instants)}; newest "
+                     f"{min(len(instants), max(top, 1) * 2)}):")
+        irows = []
+        for ev in instants[-max(top, 1) * 2:]:
+            args = dict(ev.get("args") or {})
+            r = args.pop("rank", None)
+            irows.append({
+                "t_s": round(float(ev.get("ts", 0) or 0) / 1e6, 3),
+                "rank": r, "name": ev.get("name"),
+                "args": json.dumps(args, sort_keys=True) if args
+                else ""})
+        parts.append(_fmt_table(irows, [
+            ("t_s", "t_s"), ("rank", "rank"), ("name", "name"),
+            ("args", "args")]))
+        parts.append("")
+    if not rows and not instants:
+        parts.append("(no spans or instants across the inputs)")
+    return "\n".join(parts).rstrip() + "\n"
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Summarize a trace / flight dump / request log: "
-                    "per-thread span table + top-N slow requests.")
-    ap.add_argument("path", help="trace JSON (tpu_trace), flight dump "
-                                 "(flight_*.json) or reqlog JSONL "
-                                 "(tpu_reqlog) — format is sniffed")
+                    "per-thread span table + top-N slow requests. "
+                    "--merge renders N per-rank artifacts (or one "
+                    "incident bundle) on one aligned timeline.")
+    ap.add_argument("paths", nargs="+",
+                    help="trace JSON (tpu_trace), flight dump "
+                         "(flight_*.json), incident bundle "
+                         "(incident_*.json) or reqlog JSONL "
+                         "(tpu_reqlog) — format is sniffed")
+    ap.add_argument("--merge", action="store_true",
+                    help="merge all inputs onto one rank-aware "
+                         "aligned timeline")
     ap.add_argument("--top", type=int, default=10,
                     help="slow requests / tail rows shown (default 10)")
     args = ap.parse_args(argv)
-    try:
-        kind, doc = load_artifact(args.path)
-    except (OSError, ValueError, json.JSONDecodeError) as e:
-        print(f"cannot summarize {args.path}: {e}", file=sys.stderr)
-        return 2
-    print(f"# {args.path}: {kind} artifact")
+    loaded = []
+    for path in args.paths:
+        try:
+            kind, doc = load_artifact(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"cannot summarize {path}: {e}", file=sys.stderr)
+            return 2
+        loaded.append((path, kind, doc))
+    if args.merge or len(loaded) > 1 or loaded[0][1] == "incident":
+        merged = merge_entries(loaded)
+        print(f"# merged: {', '.join(p for p, _k, _d in loaded)}")
+        print(render_merged(merged, top=max(args.top, 1)))
+        return 0
+    path, kind, doc = loaded[0]
+    print(f"# {path}: {kind} artifact")
     print(render(kind, doc, top=max(args.top, 1)))
     return 0
 
