@@ -611,18 +611,34 @@ def _auc(y, s):
 PARITY_AUC_TOL = 4e-4
 
 
+def _metric_tag() -> str:
+    """Device-kind suffix every headline metric string carries.
+    tools/check_bench_regression.py compares runs by metric-string
+    equality, so the stamp makes a CPU number structurally incomparable
+    with a GPU or TPU trajectory — the checker refuses instead of
+    ratioing across backends."""
+    from lightgbm_tpu.ops import autotune
+    return f" [{autotune.device_kind()}]"
+
+
 def _import_reference_lightgbm():
     """The reference engine, if this host can import it: the
     ``lightgbm`` PyPI package, else the fork's python-package under
     /root/reference. Returns (module, skip_reason) — exactly one is
-    None."""
+    None. The skip reason records the device kind and every import
+    path attempted, so a parity skip in a cross-backend sweep log is
+    self-explaining."""
+    attempted = ["lightgbm (sys.path)"]
     try:
         import lightgbm as ref
         return ref, None
     except ImportError as e:
         first = str(e)
+    from lightgbm_tpu.ops import autotune
+    dk = autotune.device_kind()
     ref_pkg = "/root/reference/python-package"
     if os.path.isdir(ref_pkg):
+        attempted.append(ref_pkg)
         sys.path.insert(0, ref_pkg)
         try:
             import lightgbm as ref
@@ -630,11 +646,15 @@ def _import_reference_lightgbm():
         except Exception as e:  # noqa: BLE001 — a fork without a built
             # lib_lightgbm.so raises OSError from its loader
             return None, (f"reference fork at {ref_pkg} not importable:"
-                          f" {e}")
+                          f" {e} [device_kind={dk}; attempted: "
+                          f"{', '.join(attempted)}]")
         finally:
             sys.path.remove(ref_pkg)
-    return None, f"lightgbm not importable ({first}) and no fork at " \
-                 f"{ref_pkg}"
+    attempted.append(f"{ref_pkg} (absent)")
+    return (None,
+            f"lightgbm not importable ({first}) and no fork at "
+            f"{ref_pkg} [device_kind={dk}; attempted: "
+            f"{', '.join(attempted)}]")
 
 
 def _train_reference(args, X, y, X_test, y_test):
@@ -949,7 +969,8 @@ def main():
                        f"({rank['rows']} rows x "
                        f"{rank['features']} feat, "
                        f"{rank['qsize']}-row queries, "
-                       f"{rank['iters']} iters, out-of-core)"),
+                       f"{rank['iters']} iters, out-of-core)"
+                       + _metric_tag()),
             "value": rank["routes"]["ooc"]["rows_per_s"],
             "unit": "rows/s",
         }))
@@ -963,7 +984,7 @@ def main():
                        f"({sparse['rows']} rows x "
                        f"{sparse['features']} feat, density "
                        f"{sparse['density']:g}, "
-                       f"{sparse['iters']} iters)"),
+                       f"{sparse['iters']} iters)" + _metric_tag()),
             "value": sparse["routes"]["csr"]["rows_per_s"],
             "unit": "rows/s",
         }))
@@ -980,7 +1001,7 @@ def main():
                        f"({stream['windows']} windows x "
                        f"{stream['window_rows']} rows, sample "
                        f"{stream['sample_rows']}, "
-                       f"{stream['iters']} iters)"),
+                       f"{stream['iters']} iters)" + _metric_tag()),
             "value": stream["requests_per_s"],
             "unit": "requests/s",
         }))
@@ -1312,7 +1333,7 @@ def main():
                    f"({args.rows} rows x 28 feat, {args.leaves} leaves, "
                    f"{args.max_bin} bins, {args.iters} iters, "
                    f"{g.num_devices}"
-                   " chip(s))"),
+                   " chip(s))" + _metric_tag()),
         "value": round(row_iters_per_s / 1e6, 3),
         "unit": "M row-iters/s",
         "vs_baseline": round(row_iters_per_s / BASELINE_ROW_ITERS_PER_S, 3),

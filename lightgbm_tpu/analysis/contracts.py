@@ -73,6 +73,10 @@ class RepoInfo:
     validated_knobs: Set[str] = field(default_factory=set)
     volatile_knobs: Set[str] = field(default_factory=set)
     documented_knobs: Set[str] = field(default_factory=set)
+    # pre-rename knobs accepted with a deprecation warning
+    # (config.py DEPRECATED_ALIASES keys): legitimately used without
+    # being dataclass fields
+    deprecated_aliases: Set[str] = field(default_factory=set)
 
 
 def build_repo_info(sources: List[SourceFile],
@@ -107,6 +111,14 @@ def _parse_config(sf: SourceFile, info: RepoInfo) -> None:
                                 isinstance(n.value, str) and \
                                 _KNOB_RE.match(n.value):
                             info.validated_knobs.add(n.value)
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "DEPRECATED_ALIASES"
+                for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    info.deprecated_aliases.add(k.value)
 
 
 def _parse_volatile(sf: SourceFile, info: RepoInfo) -> None:
@@ -162,7 +174,8 @@ def check_knobs(sources: List[SourceFile], info: RepoInfo
         for knob, line in _knob_uses(sf):
             reads_by_knob.setdefault(knob, set()).add(sf.rel)
             first_use.setdefault(knob, (sf.rel, line))
-            if knob not in info.config_fields:
+            if knob not in info.config_fields and \
+                    knob not in info.deprecated_aliases:
                 out.append(Finding(
                     CHECKER, "undeclared-knob", sf.rel, line,
                     f"{knob!r} is used here but is not a Config "

@@ -125,6 +125,28 @@ ALIAS_TABLE: Dict[str, str] = {
 }
 
 
+def _compile_cache_from_cpu_knob(v: Any) -> int:
+    """Value remap of the pre-rename tpu_compile_cache_cpu: its 1 (CPU
+    opt-in) is tpu_compile_cache=1; its 0 meant "CPU off, TPU still
+    on" — which is the new knob's -1 auto, NOT its 0 (that would turn
+    the cache off on TPU/GPU too)."""
+    try:
+        return 1 if int(float(v)) == 1 else -1
+    except (TypeError, ValueError):
+        return -1
+
+
+# renamed knobs accepted with a deprecation warning. Unlike
+# ALIAS_TABLE these remap the VALUE too, so they are resolved in
+# Config.set() (on the normalized pre-alias key), not in
+# key_alias_transform — an alias-table entry would silently pass the
+# old value through with changed semantics.
+DEPRECATED_ALIASES = {
+    "tpu_compile_cache_cpu": ("tpu_compile_cache",
+                              _compile_cache_from_cpu_knob),
+}
+
+
 @dataclass
 class Config:
     """All parameters with reference defaults (include/LightGBM/config.h)."""
@@ -481,13 +503,18 @@ class Config:
     # shapes (one trace per batch size); N > 0 = round up to a
     # multiple of N.
     tpu_serve_bucket: int = -1
-    # persistent XLA compile cache on NON-TPU backends (ops/autotune.py
-    # ensure_compile_cache): the cache is always wired on TPU, but this
-    # image's jax 0.4.x CPU backend flakily segfaults while
-    # DESERIALIZING warm entries (~1/3 of warm runs), so CPU defaults to
-    # recompiling. 1 = opt in on jax >= 0.5 (where the deserializer is
-    # fixed); ignored with a warning on older jax. 0 = off (default).
-    tpu_compile_cache_cpu: int = 0
+    # persistent XLA compile cache, backend-aware (ops/autotune.py
+    # ensure_compile_cache): -1 = auto — wired on TPU and GPU (where
+    # the expensive Mosaic/Triton compiles live and deserialization is
+    # sound), off on CPU because this image's jax 0.4.x CPU backend
+    # flakily segfaults while DESERIALIZING warm entries (~1/3 of warm
+    # runs). 1 = on everywhere, with the CPU side gated on jax >= 0.5
+    # (where the deserializer is fixed; older jax warns and stays
+    # off). 0 = off on every backend. An explicit
+    # jax_compilation_cache_dir always wins. Replaces the CPU-only
+    # tpu_compile_cache_cpu (accepted as a warned alias: its 1 maps to
+    # 1, its 0 to the -1 auto default).
+    tpu_compile_cache: int = -1
     # cross-thread span trace (obs/trace.py): write a Chrome
     # trace-event / Perfetto-loadable JSON here showing ingest worker
     # chunks, training iterations, step-cache compiles/hits, watchdog
@@ -684,7 +711,15 @@ class Config:
         """Config::Set (src/io/config.cpp:153): alias-resolve, parse, check."""
         resolved: Dict[str, Any] = {}
         for k, v in params.items():
-            ck = self.key_alias_transform(k)
+            nk = k.strip().lower().replace("-", "_")
+            if nk in DEPRECATED_ALIASES:
+                ck, remap = DEPRECATED_ALIASES[nk]
+                nv = remap(v)
+                log.warning("%s is deprecated; use %s (mapped %s=%s to "
+                            "%s=%s)", nk, ck, nk, v, ck, nv)
+                v = nv
+            else:
+                ck = self.key_alias_transform(k)
             if ck in resolved and str(resolved[ck]) != str(v):
                 log.warning(
                     "%s is set with %s=%s, will be overridden by %s=%s",
@@ -921,10 +956,10 @@ class Config:
                         "(power-of-two serve buckets)",
                         self.tpu_serve_bucket)
             self.tpu_serve_bucket = -1
-        if self.tpu_compile_cache_cpu not in (0, 1):
-            log.warning("tpu_compile_cache_cpu=%d is not 0/1; using 0 "
-                        "(off)", self.tpu_compile_cache_cpu)
-            self.tpu_compile_cache_cpu = 0
+        if self.tpu_compile_cache not in (-1, 0, 1):
+            log.warning("tpu_compile_cache=%d is not one of -1/0/1; "
+                        "using -1 (auto)", self.tpu_compile_cache)
+            self.tpu_compile_cache = -1
         if self.tpu_trace_buffer < 1024:
             log.warning("tpu_trace_buffer=%d is below the floor; "
                         "using 1024", self.tpu_trace_buffer)

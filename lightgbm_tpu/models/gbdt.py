@@ -117,8 +117,7 @@ class GBDT:
         from ..ops import autotune, step_cache
         autotune.configure(config.tpu_autotune,
                            config.tpu_tuning_cache or None)
-        autotune.ensure_compile_cache(
-            cpu_opt_in=config.tpu_compile_cache_cpu == 1)
+        autotune.ensure_compile_cache(mode=config.tpu_compile_cache)
         # process-wide compiled-step registry (ops/step_cache.py):
         # eligible boosters share ONE jitted training step per geometry
         step_cache.configure(config.tpu_step_cache, config.tpu_row_bucket)
@@ -574,17 +573,21 @@ class GBDT:
                     # would reshard every shard boundary
                     self._pad_rows = ing
         elif mode == "serial":
-            from ..utils.device import on_tpu
-            if on_tpu():
+            from ..utils.device import backend_kind
+            if backend_kind() in ("tpu", "gpu"):
+                # both Pallas kernel families pad rows to a chunk
+                # multiple internally — aligning up front avoids the
+                # per-step re-pad
                 self._pad_rows = (-self._n) % kchunk
         # alignment unit the row padding above respects — the bucketed
         # score width must stay a multiple of it (even shards for the
-        # data/voting learners, chunk-aligned rows for the TPU kernels)
+        # data/voting learners, chunk-aligned rows for the accelerator
+        # kernels)
         if mode in ("data", "voting"):
             unit = step_cache.shard_align_unit(self._n, D, kchunk)
         elif mode == "serial":
-            from ..utils.device import on_tpu
-            unit = kchunk if on_tpu() else 1
+            from ..utils.device import backend_kind
+            unit = kchunk if backend_kind() in ("tpu", "gpu") else 1
         else:
             unit = 1
         self._row_align_unit = unit
@@ -702,6 +705,7 @@ class GBDT:
                         "multi-device mesh; the serial histogram has "
                         "no collective to overlap")
 
+        from ..ops.autotune import tune_hist_route
         gcfg = WaveGrowerConfig(
             num_leaves=max(cfg.num_leaves, 2),
             # >= 2 so the per-feature split scan is never empty (the
@@ -722,7 +726,14 @@ class GBDT:
             quant_psum=quant_psum,
             psum_wire=psum_wire,
             psum_slots=psum_slots,
-            sparse_hist=sparse_tier)
+            sparse_hist=sparse_tier,
+            # resolved per device kind so the step-cache geometry key
+            # (which hashes this config) separates programs compiled
+            # for different kernel families — a GPU-route step never
+            # serves a CPU restore of the same geometry
+            route=tune_hist_route(
+                fused_eligible=not self._use_bundles
+                and not sparse_tier))
         self._grower_cfg = gcfg
         hist_fn = None
         efb_feature = None

@@ -223,6 +223,131 @@ def tpu_compiler_params(*, vmem_limit_bytes: int = PALLAS_VMEM_LIMIT_BYTES):
 
 
 # ---------------------------------------------------------------------------
+# GPU (Pallas-Triton) tier geometry: shared-memory pricing, per-CTA tiles
+# ---------------------------------------------------------------------------
+
+# per-CTA shared-memory budget the GPU tile guards admit against — the
+# smem role VMEM plays on TPU, with the same headroom discipline: 48 KB
+# is the portable static-smem floor every supported CUDA arch provides
+# without opt-in dynamic carve-outs, and the Triton compiler's own
+# reduction scratch must fit beside our blocks
+GPU_SMEM_LIMIT_BYTES = 48 * 1024
+GPU_SMEM_BUDGET_BYTES = 40 * 1024
+
+# default per-CTA row tile of the GPU histogram kernels (the role
+# DEFAULT_HIST_CHUNK plays on TPU; the histogram itself accumulates in
+# global memory via atomics, so the row tile prices only the streamed
+# bins/gradient blocks — much smaller tiles than the TPU's 8k/16k
+# VMEM-resident chunks)
+DEFAULT_GPU_HIST_CHUNK = 1024
+DEFAULT_GPU_ROW_TILE = 1024
+
+
+def gpu_hist_block_shapes(*, chunk: int, geom: Dict[str, int],
+                          fused: bool, tbl_rows: Optional[int] = None
+                          ) -> Dict[str, tuple]:
+    """Per-CTA block shapes of the GPU wave/fused histogram kernels —
+    their BlockSpecs are built from THESE tuples (same can't-drift
+    contract as wave_hist_block_shapes on TPU). The histogram output
+    lives in global memory (atomic accumulation), so only the streamed
+    row blocks and the small split tables are priced."""
+    s = {
+        "wl": (geom["wp"],),                              # i32 const
+        "bins": (geom["F_rows"], chunk),                  # grid-indexed
+        "gh": (2, chunk),                                 # grid-indexed
+    }
+    if fused:
+        if tbl_rows is None:
+            from .hist_wave import TBL_ROWS
+            tbl_rows = TBL_ROWS
+        s["tbl"] = (tbl_rows, geom["wp"])                 # i32 const
+        s["mask"] = (chunk,)                              # grid-indexed
+        s["leaf"] = (chunk,)                              # grid-indexed
+        s["leaf_out"] = (chunk,)                          # grid-indexed
+    return s
+
+
+def gpu_hist_smem_bytes(*, chunk: int, geom: Dict[str, int], fused: bool,
+                        bins_bytes: int = 1,
+                        tbl_rows: Optional[int] = None) -> int:
+    """Working-set bytes of one GPU histogram CTA, priced from the SAME
+    block shapes the BlockSpecs use plus the per-row temporaries (the
+    [F] flat-index/value vectors of the atomic scatter)."""
+    s = gpu_hist_block_shapes(chunk=chunk, geom=geom, fused=fused,
+                              tbl_rows=tbl_rows)
+    b = (_nelem(s["bins"]) * bins_bytes
+         + _nelem(s["gh"]) * 4
+         + _nelem(s["wl"]) * 4)
+    if fused:
+        b += (_nelem(s["tbl"]) * 4
+              + _nelem(s["mask"]) * 4
+              + 2 * _nelem(s["leaf"]) * 4)
+    # per-row scatter temporaries: [F] i32 flat indices + [F] f32 vals
+    # per channel (3 channels), plus the [W] slot-compare vector
+    b += geom["F_rows"] * 4 * 4 + geom["wp"] * 4
+    return b
+
+
+def fits_smem(nbytes: int) -> bool:
+    return nbytes <= GPU_SMEM_BUDGET_BYTES
+
+
+def gpu_compiler_params(*, num_warps: int = 4, num_stages: int = 2):
+    """Version-portable Pallas-Triton CompilerParams, or None when the
+    Triton lowering is absent (interpret-mode callers pass None)."""
+    try:
+        from jax.experimental.pallas import triton as plgpu
+    except ImportError:
+        return None
+    cls = getattr(plgpu, "CompilerParams", None) \
+        or getattr(plgpu, "TritonCompilerParams", None)
+    if cls is None:
+        return None
+    return cls(num_warps=num_warps, num_stages=num_stages)
+
+
+@functools.lru_cache(maxsize=1)
+def gpu_pallas_supported() -> bool:
+    """Is the Pallas-Triton lowering importable in this jax? Gates the
+    pallas-gpu route (tune_hist_route) and the gpu_tier test module's
+    clean skip — capability, not device presence (interpret-mode parity
+    runs on any backend)."""
+    try:
+        from jax.experimental.pallas import triton  # noqa: F401
+        return True
+    except Exception:       # noqa: BLE001 — absent lowering = no route
+        return False
+
+
+# the capability ladder of the histogram hot loop, best-first; the
+# chosen rung rides WaveGrowerConfig.route into the step-cache geometry
+# key (different backends = different compiled programs)
+HIST_ROUTES = ("pallas-tpu", "pallas-gpu", "fused-xla", "two-pass")
+
+
+def tune_hist_route(*, backend: Optional[str] = None,
+                    use_pallas: Optional[bool] = None,
+                    fused_eligible: bool = True) -> str:
+    """The histogram hot-loop route for this backend, by capability:
+    the device's own Pallas tier when it can lower ("pallas-tpu" /
+    "pallas-gpu" — the Triton rung additionally needs the Pallas-Triton
+    lowering importable), else the fused single-pass XLA kernel, else
+    the legacy two-pass partition+histogram. ``use_pallas`` is the
+    config override (None = auto); ``fused_eligible`` is the caller's
+    structural gate (default kernel seams, no EFB bundles, no sparse
+    tier — ops/wave_grower.py owns it)."""
+    from ..utils.device import backend_kind
+    b = backend or backend_kind()
+    pallas = use_pallas if use_pallas is not None else (
+        b == "tpu" or (b == "gpu" and gpu_pallas_supported()))
+    if pallas:
+        return "pallas-gpu" if b == "gpu" else "pallas-tpu"
+    if fused_eligible:
+        return "fused-xla"
+    return "two-pass"
+
+
+# ---------------------------------------------------------------------------
 # Tuning cache (versioned JSON on disk)
 # ---------------------------------------------------------------------------
 
@@ -400,44 +525,58 @@ def _jax_version() -> tuple:
 
 
 def ensure_compile_cache(path: Optional[str] = None,
-                         cpu_opt_in: bool = False) -> None:
+                         cpu_opt_in: bool = False,
+                         mode: Optional[int] = None) -> None:
     """Wire jax's persistent compilation cache so the grower/predict
     kernels compile once per machine, not once per process (~tens of
     seconds per distinct shape on TPU). Idempotent; an explicit
     operator/test setting of jax_compilation_cache_dir is respected.
 
-    Auto-enabled only for the TPU backend: that is where the expensive
-    Mosaic compiles live, and this image's jax 0.4.x CPU backend
-    flakily segfaults while DESERIALIZING warm cache entries (observed
-    ~1/3 of warm-cache test runs) — a CPU process recompiles instead.
-    ``cpu_opt_in`` (config.tpu_compile_cache_cpu) enables the cache on
-    non-TPU backends, gated on jax >= 0.5 where the CPU
-    cache-deserialization path is fixed — on older jax it warns and
-    stays off (the original segfault note above). An operator can
-    always set jax_compilation_cache_dir explicitly (it is respected
-    on any jax)."""
+    ``mode`` is config.tpu_compile_cache's tri-state. The policy
+    matrix (Design.md §5i):
+
+    ========  ==========  =======  ========
+    backend   -1 (auto)   0 (off)  1 (on)
+    ========  ==========  =======  ========
+    tpu       on          off      on
+    gpu       on          off      on
+    cpu       off         off      jax>=0.5
+    ========  ==========  =======  ========
+
+    TPU and GPU auto-enable: that is where the expensive Mosaic /
+    Triton compiles live, and their deserialization paths are sound.
+    The CPU backend stays opt-in because this image's jax 0.4.x
+    flakily segfaults while DESERIALIZING warm CPU cache entries
+    (observed ~1/3 of warm-cache test runs) — mode=1 on CPU is gated
+    on jax >= 0.5 where that path is fixed; on older jax it warns and
+    stays off. An operator can always set jax_compilation_cache_dir
+    explicitly (it is respected on any jax and any backend).
+    ``cpu_opt_in`` is the pre-rename kwarg (tpu_compile_cache_cpu),
+    kept for callers that predate ``mode``: True maps to mode=1."""
     global _compile_cache_done
     if _compile_cache_done:
         return
+    if mode is None:
+        mode = 1 if cpu_opt_in else -1
     import jax
     try:
         _compile_cache_done = True
         if getattr(jax.config, "jax_compilation_cache_dir", None):
             return                       # operator already configured it
-        from ..utils.device import on_tpu
-        if not on_tpu():
-            if not cpu_opt_in:
-                # NOT a terminal decision: a later booster may opt in
-                # (tpu_compile_cache_cpu=1), so leave the flag unset
-                _compile_cache_done = False
-                return
-            if _jax_version() < (0, 5):
-                log.warning(
-                    "tpu_compile_cache_cpu=1 needs jax >= 0.5 (this "
-                    "jax %s flakily segfaults deserializing warm CPU "
-                    "cache entries); leaving the persistent compile "
-                    "cache off", jax.__version__)
-                return
+        from ..utils.device import backend_kind
+        backend = backend_kind()
+        if mode == 0 or (backend == "cpu" and mode != 1):
+            # NOT a terminal decision: a later booster may opt in
+            # (tpu_compile_cache=1), so leave the flag unset
+            _compile_cache_done = False
+            return
+        if backend == "cpu" and _jax_version() < (0, 5):
+            log.warning(
+                "tpu_compile_cache=1 on the CPU backend needs jax >= "
+                "0.5 (this jax %s flakily segfaults deserializing "
+                "warm CPU cache entries); leaving the persistent "
+                "compile cache off", jax.__version__)
+            return
         from ..io.dataset import default_cache_dir
         jax.config.update("jax_compilation_cache_dir",
                           path or os.path.join(default_cache_dir(), "xla"))
@@ -480,22 +619,76 @@ def hist_chunk_candidates(*, F: int, B: int, W: int, fused: bool,
     return out[::-1]
 
 
+def gpu_hist_chunk_candidates(*, F: int, B: int, W: int, fused: bool,
+                              bins_bytes: int = 1, packed4: bool = False,
+                              n_rows: int = 0, exhaustive: bool = False
+                              ) -> List[dict]:
+    """Shared-memory-feasible per-CTA row tiles for the GPU histogram
+    kernels, largest-first — the same candidate-guard contract as
+    hist_chunk_candidates, priced by gpu_hist_smem_bytes instead of
+    hist_vmem_bytes. The int8 overflow guard does not apply: the GPU
+    quantized tier accumulates int32 in GLOBAL memory (per-cell atomic
+    adds), not a per-chunk VMEM-resident plane."""
+    geom = hist_geometry(F=F, B=B, W=W,
+                         F_rows=(F + 1) // 2 if packed4 else F)
+    base = ((128, 256, 512, 1024, 2048, 4096) if exhaustive
+            else (256, 512, 1024, 2048))
+    out = []
+    for c in base:
+        if n_rows and c > max(n_rows, base[0]):
+            continue
+        if fits_smem(gpu_hist_smem_bytes(chunk=c, geom=geom, fused=fused,
+                                         bins_bytes=bins_bytes)):
+            out.append({"chunk": c})
+    return out[::-1]
+
+
 def tune_hist_chunk(*, fused: bool, F: int, B: int, W: int,
                     precision: str = "highest", count_proxy: bool = False,
                     packed4: bool = False, any_cat: bool = False,
                     bins_bytes: int = 1, n_rows: int = 0,
-                    variant: Optional[str] = None) -> int:
+                    variant: Optional[str] = None, _measure=None) -> int:
     """The row chunk the histogram hot path should run with — tuned on
     first encounter of this (kernel, F, B, tier, device) key, cached
-    thereafter. Off-TPU (and with tpu_autotune=off) this returns the
-    measured per-tier default untouched."""
+    thereafter. On CPU (and with tpu_autotune=off) this returns the
+    measured per-tier default untouched. The GPU arm tunes per-CTA row
+    tiles against the shared-memory budget (gpu_hist_chunk_candidates)
+    under its own kernel names, so cached TPU decisions are untouched;
+    timing needs a real GPU — ``_measure`` injects a fake timer so the
+    decision logic unit-tests off-GPU (it routes the GPU arm on any
+    non-TPU backend)."""
     int8 = precision == "int8"
     default = DEFAULT_HIST_CHUNK_INT8 if int8 else DEFAULT_HIST_CHUNK
     t = tuner()
-    from ..utils.device import on_tpu
-    if t.mode == "off" or not on_tpu():
+    from ..utils.device import backend_kind
+    backend = backend_kind()
+    if t.mode == "off" or (backend == "cpu" and _measure is None):
         return default
     variant = variant if precision == "highest" else None
+    if backend == "gpu" or (backend != "tpu" and _measure is not None):
+        cands = gpu_hist_chunk_candidates(
+            F=F, B=B, W=W, fused=fused, bins_bytes=bins_bytes,
+            packed4=packed4, n_rows=n_rows,
+            exhaustive=t.mode == "exhaustive")
+        if not cands:
+            return DEFAULT_GPU_HIST_CHUNK
+        if len(cands) == 1:
+            return int(cands[0]["chunk"])
+        tier = precision + ("+proxy" if count_proxy else "") \
+            + ("+packed4" if packed4 else "")
+        key = {"F": F, "B": B, "W": W, "tier": tier, "fused": fused,
+               "cat": bool(any_cat), "bins_bytes": bins_bytes,
+               "device": device_kind(),
+               "chunks": [c["chunk"] for c in cands]}
+        measure = _measure or _hist_measure_fn_gpu(
+            fused=fused, F=F, B=B, W=W, precision=precision,
+            count_proxy=count_proxy, packed4=packed4, any_cat=any_cat,
+            bins_bytes=bins_bytes,
+            n_meas=_hist_measure_rows(cands, F, bins_bytes))
+        choice = t.best("fused_hist_gpu" if fused else "wave_hist_gpu",
+                        key, cands, measure,
+                        default={"chunk": DEFAULT_GPU_HIST_CHUNK})
+        return int(choice["chunk"])
     cands = hist_chunk_candidates(
         F=F, B=B, W=W, fused=fused, bins_bytes=bins_bytes, int8=int8,
         count_proxy=count_proxy, packed4=packed4, n_rows=n_rows,
@@ -561,11 +754,15 @@ def tune_exact_tier(*, F: int, B: int, n_rows: int = 0,
     feasible layouts are timed once (fused kernel at each layout's own
     wave cap, wall NORMALIZED PER SPLIT — t/W — because the layouts
     trade MXU dots per pass against passes per tree) and the winner is
-    cached; off-TPU the XLA oracle is layout-free, so the variant only
-    sets the wave-width cap and the analytic choice is the widest
-    feasible wave (fewer full-data scatter passes per tree — the
-    measured off-TPU win). tpu_autotune=off pins the pre-variant
-    "hilo5". ``_measure`` injects a fake timer (unit tests)."""
+    cached; off-TPU the choice is ANALYTIC — the CPU XLA oracle is
+    layout-free, and the GPU scatter kernels accumulate one full-f32
+    channel per plane (no 128-lane budget to split), so on both the
+    variant only sets the wave-width cap and the widest feasible wave
+    wins (fewer full-data scatter passes per tree — the measured
+    off-TPU win). tpu_autotune=off pins the pre-variant "hilo5".
+    ``_measure`` injects a fake timer (unit tests; it forces the timed
+    arm on any backend — the key's device field keeps entries
+    apart)."""
     if requested:
         if requested == "hilo3" and not constant_hessian:
             log.warning(
@@ -580,6 +777,7 @@ def tune_exact_tier(*, F: int, B: int, n_rows: int = 0,
         return "hilo5"
     from ..utils.device import on_tpu
     if not on_tpu() and _measure is None:
+        # the analytic arm — CPU and GPU alike (see docstring)
         return cands[0]["variant"]
     key = {"F": F, "B": B, "cat": bool(any_cat),
            "bins_bytes": bins_bytes, "device": device_kind(),
@@ -622,10 +820,16 @@ def _exact_tier_measure_fn(*, F, B, any_cat, bins_bytes, n_rows):
 # rule (not a timed sweep) because the tier also changes EXACTNESS
 # (see tune_hist_tier), so auto only engages where it is bit-equal
 SPARSE_TIER_MAX_DENSITY = 0.125
+# the GPU arm's lower ceiling: on the gpu route, choosing the sparse
+# tier forfeits the pallas-gpu fused kernel (the sparse tier runs the
+# XLA scatter path), so the sparse side must win by more than it does
+# on backends where both tiers are XLA
+SPARSE_TIER_MAX_DENSITY_GPU = 1.0 / 16.0
 
 
 def tune_hist_tier(*, requested: int, density: float, nnz: int,
-                   F: int, B: int, W: int, quant: bool) -> bool:
+                   F: int, B: int, W: int, quant: bool,
+                   backend: Optional[str] = None) -> bool:
     """True = the sparse histogram tier (ops/hist_wave.py
     wave_histogram_sparse, scatter over nnz) serves this booster;
     False = the dense one-hot tier. Selected per (density, geometry)
@@ -637,9 +841,11 @@ def tune_hist_tier(*, requested: int, density: float, nnz: int,
     The auto rule is exactness-first: integer (quantized) accumulation
     is order-free, so the sparse completion subtraction is BIT-equal
     to the dense tier — auto therefore requires ``quant`` AND density
-    under SPARSE_TIER_MAX_DENSITY. tpu_sparse=1 forces the tier for
-    f32 histograms too (final-ulp reassociation drift vs the dense
-    tier is possible; logged)."""
+    under the backend's ceiling (SPARSE_TIER_MAX_DENSITY, or the lower
+    SPARSE_TIER_MAX_DENSITY_GPU on the gpu route — ``backend`` pins it
+    for decision unit tests, None reads the live backend_kind()).
+    tpu_sparse=1 forces the tier for f32 histograms too (final-ulp
+    reassociation drift vs the dense tier is possible; logged)."""
     if requested == 0:
         return False
     if requested == 1:
@@ -651,7 +857,12 @@ def tune_hist_tier(*, requested: int, density: float, nnz: int,
         return True
     if not quant:
         return False
-    return float(density) <= SPARSE_TIER_MAX_DENSITY
+    if backend is None:
+        from ..utils.device import backend_kind
+        backend = backend_kind()
+    ceiling = (SPARSE_TIER_MAX_DENSITY_GPU if backend == "gpu"
+               else SPARSE_TIER_MAX_DENSITY)
+    return float(density) <= ceiling
 
 
 # ---------------------------------------------------------------------------
@@ -925,6 +1136,73 @@ def _hist_measure_fn(*, fused: bool, F: int, B: int, W: int,
                 precision=precision, gh_scale=gh_scale,
                 count_proxy=count_proxy, packed4=packed4,
                 num_features=F if packed4 else None, variant=variant)
+
+    return lambda cand: timing.measure(
+        functools.partial(run, int(cand["chunk"])))
+
+
+def _hist_measure_fn_gpu(*, fused: bool, F: int, B: int, W: int,
+                         precision: str, count_proxy: bool, packed4: bool,
+                         any_cat: bool, bins_bytes: int, n_meas: int):
+    """measure(candidate) for the GPU histogram kernels — the same
+    synthetic-data harness as _hist_measure_fn, pointed at the
+    Pallas-Triton kernels (non-interpret: this path only runs when a
+    real GPU is the backend; unit tests inject ``_measure`` instead).
+    No ``variant`` knob: the GPU scatter is layout-free, every hilo
+    variant lowers to the same kernel."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from .hist_wave import (fused_partition_histogram_pallas_gpu,
+                            wave_histogram_pallas_gpu)
+
+    rng = np.random.default_rng(0)
+    int8 = precision == "int8"
+    F_rows = (F + 1) // 2 if packed4 else F
+    bdt = np.uint8 if bins_bytes == 1 else np.int32
+    bmax = 255 if packed4 else max(B - 1, 1)
+    bins = jnp.asarray(rng.integers(0, bmax + 1, (F_rows, n_meas),
+                                    dtype=np.int64).astype(bdt))
+    if int8:
+        g = jnp.asarray(rng.integers(-127, 128, n_meas).astype(np.float32))
+        h = jnp.asarray(rng.integers(0, 128, n_meas).astype(np.float32))
+        gh_scale = (1.0, 1.0)
+    else:
+        g = jnp.asarray(rng.normal(size=n_meas).astype(np.float32))
+        h = jnp.asarray(np.abs(rng.normal(size=n_meas)).astype(np.float32))
+        gh_scale = None
+    leaf_ids = jnp.zeros(n_meas, jnp.int32)
+    if fused:
+        mask = jnp.ones(n_meas, jnp.float32)
+        col = np.full(W, -1, np.int32)
+        tbl = np.zeros((18, W), np.int32)
+        tbl[0] = col                     # TBL_PARENT
+        tbl[1] = col                     # TBL_NEW
+        tbl[0, 0], tbl[1, 0] = 0, 1
+        tbl[3, 0] = B // 2               # TBL_BIN
+        tbl[7] = B                       # TBL_NUMBIN
+        tbl[8] = col                     # TBL_SMALL
+        tbl[8, 0] = 1
+        tbl_d = jnp.asarray(tbl)
+
+        def run(chunk):
+            return fused_partition_histogram_pallas_gpu(
+                bins, g, h, mask, leaf_ids, tbl_d, num_bins=B,
+                chunk=chunk, precision=precision, gh_scale=gh_scale,
+                any_cat=any_cat, count_proxy=count_proxy,
+                packed4=packed4, num_features=F if packed4 else None)
+    else:
+        wl = jnp.asarray(np.concatenate(
+            [np.zeros(1, np.int32), np.full(W - 1, -1, np.int32)])
+            if W > 1 else np.zeros(1, np.int32))
+
+        def run(chunk):
+            return wave_histogram_pallas_gpu(
+                bins, g, h, leaf_ids, wl, num_bins=B, chunk=chunk,
+                precision=precision, gh_scale=gh_scale,
+                count_proxy=count_proxy, packed4=packed4,
+                num_features=F if packed4 else None)
 
     return lambda cand: timing.measure(
         functools.partial(run, int(cand["chunk"])))

@@ -34,10 +34,14 @@ What had to move out of the per-instance closures to get there:
   geometry instead of recompiling per window.
 
 The registry key covers everything that shapes the trace (learner
-mode, mesh device ids, WaveGrowerConfig incl. split hyperparameters
-and forced splits, valid-set slice layout, bins dtype/shape, objective
-static key, aux structure, renew spec, sample-hook statics), so a hit
-is guaranteed to be a functionally identical program. Ineligible
+mode, mesh device ids, WaveGrowerConfig incl. split hyperparameters,
+forced splits and the resolved histogram ``route`` — pallas-tpu /
+pallas-gpu / fused-xla / two-pass, so the same geometry on a different
+backend compiles its own program and a checkpoint restored onto
+another device kind re-resolves and re-keys instead of replaying a
+foreign kernel choice — valid-set slice layout, bins dtype/shape,
+objective static key, aux structure, renew spec, sample-hook statics),
+so a hit is guaranteed to be a functionally identical program. Ineligible
 configurations (EFB bundles, feature/voting learners, RF's averaging
 step, legacy-PRNG GOSS under ``tpu_goss_hash=0`` — its in-jit sampler
 draws a positional PRNG stream whose values depend on the padded

@@ -124,6 +124,13 @@ class WaveGrowerConfig(NamedTuple):
     # only; excludes the fused kernel, count-proxy, packed4 and
     # injected seams.
     sparse_hist: bool = False
+    # resolved histogram route (ops/autotune.py tune_hist_route):
+    # "pallas-tpu" | "pallas-gpu" | "fused-xla" | "two-pass"; "" = auto
+    # by backend. models/gbdt.py stamps the resolved value here so the
+    # step-cache geometry key separates per-backend programs — a
+    # checkpoint restored onto a different device kind re-resolves and
+    # recompiles instead of replaying the wrong kernel family.
+    route: str = ""
 
 
 class _State(NamedTuple):
@@ -154,14 +161,6 @@ class _State(NamedTuple):
     n_splits: jax.Array        # scalar int32 (= num_leaves - 1)
     go_on: jax.Array           # scalar bool
     rec: TreeRecord
-
-
-def _pallas_on(use_pallas: bool | None) -> bool:
-    """Resolve the use_pallas tri-state the same way wave_histogram does."""
-    if use_pallas is None:
-        from ..utils.device import on_tpu
-        return on_tpu()
-    return use_pallas
 
 
 _SUM_BLOCK = 8192
@@ -345,6 +344,18 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                              "injected histogram/partition seams or "
                              "the sparse tier")
     bundled = jnp.ndim(meta_const.bundle) != 0
+    # resolve the histogram route once: an explicit cfg.route pins the
+    # kernel family (and rode the step-cache geometry key to get here);
+    # otherwise the device kind decides (autotune.tune_hist_route)
+    from . import autotune
+    if cfg.route and cfg.route not in autotune.HIST_ROUTES:
+        raise ValueError(f"unknown hist route {cfg.route!r} "
+                         f"(want one of {autotune.HIST_ROUTES})")
+    route = cfg.route or autotune.tune_hist_route(
+        use_pallas=cfg.use_pallas,
+        fused_eligible=cfg.fused is not False)
+    gpu_hist = route == "pallas-gpu"
+    pallas_hist = route in ("pallas-tpu", "pallas-gpu")
     use_fused = cfg.fused
     if use_fused is None:
         from .hist_wave import (FUSED_MAX_WAVE, FUSED_MAX_WAVE_HILO,
@@ -359,12 +370,23 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                            "hilo3": FUSED_MAX_WAVE_HILO3}[
                                cfg.exact_variant]
                      if cfg.precision == "highest" else FUSED_MAX_WAVE)
-        use_fused = (default_seams and W <= fused_cap
+        # the GPU fused kernel accumulates by atomics into global
+        # memory — no lane budget, so no wave-width cap applies there
+        use_fused = (default_seams and (gpu_hist or W <= fused_cap)
                      and not bundled and not cfg.sparse_hist
-                     and _pallas_on(cfg.use_pallas))
+                     and pallas_hist)
     if use_fused:
-        from ..utils.device import on_tpu
-        fused_interpret = not on_tpu()
+        from ..utils.device import backend_kind, on_tpu
+        # interpret mode runs the kernel off its native accelerator
+        # (the tier-1 parity suite drives both kernel families on CPU)
+        fused_interpret = (backend_kind() != "gpu" if gpu_hist
+                           else not on_tpu())
+        from .hist_wave import fused_partition_histogram_pallas_gpu
+        fused_kernel_fn = (fused_partition_histogram_pallas_gpu
+                           if gpu_hist
+                           else fused_partition_histogram_pallas)
+        fused_chunk = cfg.chunk or (autotune.DEFAULT_GPU_HIST_CHUNK
+                                    if gpu_hist else DEFAULT_HIST_CHUNK)
     # off-TPU twin of the fused kernel (ops/hist_wave.py
     # fused_partition_histogram_xla): partition + smaller-child
     # histogram in one traced region, reusing the leaf-membership
@@ -376,7 +398,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
     use_fused_xla = (not use_fused and cfg.fused is not False
                      and default_seams and not bundled
                      and not cfg.sparse_hist
-                     and not _pallas_on(cfg.use_pallas))
+                     and not pallas_hist)
     if use_fused_xla:
         from .hist_wave import fused_partition_histogram_xla
 
@@ -392,6 +414,11 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                 sp, g, h, leaf_ids, wave_leaves, num_bins=B,
                 num_features=bt.shape[0], gh_scale=gh_scale)
     elif hist_fn is None:
+        # the two-pass wave histogram rides the resolved route too —
+        # "two-pass" maps to the layout-free XLA scatter inside the
+        # dispatcher, the pallas tiers to their device kernel
+        hist_route = ("two-pass" if route == "fused-xla" else route)
+
         def hist_fn(bins_t, g, h, leaf_ids, wave_leaves, gh_scale=None):
             return wave_histogram(bins_t, g, h, leaf_ids, wave_leaves,
                                   num_bins=B, chunk=cfg.chunk,
@@ -399,7 +426,8 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                                   precision=cfg.precision,
                                   gh_scale=gh_scale,
                                   dequant=not defer,
-                                  variant=cfg.exact_variant)
+                                  variant=cfg.exact_variant,
+                                  route=hist_route)
 
     # default split/partition seams take meta as a CALL parameter (the
     # compiled-step registry passes a traced override); injected seams
@@ -584,10 +612,16 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
             # matching tier — no partition logic to pay for on an
             # unsplit tree, and (packed4) the default hist_fn never
             # sees the packed byte rows the fused path keeps in HBM
-            from .hist_wave import wave_histogram_pallas
-            local_root = wave_histogram_pallas(
+            from .hist_wave import (wave_histogram_pallas,
+                                    wave_histogram_pallas_gpu)
+            wave_kernel = (wave_histogram_pallas_gpu if gpu_hist
+                           else wave_histogram_pallas)
+            root_chunk = cfg.chunk or (
+                autotune.DEFAULT_GPU_HIST_CHUNK if gpu_hist
+                else DEFAULT_HIST_CHUNK)
+            local_root = wave_kernel(
                 bins_t, hg, hh, bag_mask_ids(leaf0), root_wl,
-                num_bins=B, chunk=cfg.chunk or DEFAULT_HIST_CHUNK,
+                num_bins=B, chunk=root_chunk,
                 interpret=fused_interpret, precision=cfg.precision,
                 gh_scale=gh_scale, count_proxy=proxy,
                 packed4=cfg.packed4,
@@ -733,10 +767,10 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
                     meta.default_bin[safe_feat],
                     meta.num_bin[safe_feat], small_ids,
                     iscat.astype(jnp.int32)]), catw.T])      # [18, W]
-                fused_out = fused_partition_histogram_pallas(
+                fused_out = fused_kernel_fn(
                     bins_t, hg, hh, sample_mask,
                     state.leaf_ids, tbl, num_bins=B,
-                    chunk=cfg.chunk or DEFAULT_HIST_CHUNK,
+                    chunk=fused_chunk,
                     interpret=fused_interpret,
                     precision=cfg.precision, gh_scale=gh_scale,
                     any_cat=bool(hp.has_cat), count_proxy=proxy,
@@ -1027,6 +1061,7 @@ def make_wave_grower(cfg: WaveGrowerConfig, meta: FeatureMeta,
         return rec, state.leaf_ids
 
     # jit-capture: ok(B, hp, cfg, quant, use_fused, use_fused_xla,
+    # fused_chunk, fused_interpret, gpu_hist, fused_kernel_fn,
     # fused_partition_histogram_xla, meta_const,
     # bound_counts, depth_ok, hist_fn, hist_reduce_fn, reduce_fn,
     # max_reduce_fn, row_offset_fn, split_fn, partition_fn) —

@@ -79,7 +79,7 @@ VOLATILE_KNOBS = frozenset({
     "tpu_run_report", "tpu_trace", "tpu_trace_buffer",
     "tpu_metrics_export", "tpu_metrics_interval_s", "tpu_metrics_port",
     "tpu_profile_dir", "tpu_profile_iters", "tpu_watchdog_factor",
-    "tpu_autotune", "tpu_tuning_cache", "tpu_compile_cache_cpu",
+    "tpu_autotune", "tpu_tuning_cache", "tpu_compile_cache",
     "tpu_checkpoint_dir", "tpu_checkpoint_freq", "tpu_snapshot_keep",
     "tpu_resume_from", "tpu_faults", "tpu_fault_seed",
     "tpu_retry_attempts",

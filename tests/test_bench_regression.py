@@ -160,6 +160,63 @@ def test_compare_refuses_cross_workload():
     assert len(probs) == 1 and "not comparable" in probs[0]
 
 
+def test_compare_refuses_cross_backend():
+    """bench.py stamps the device kind into the metric string
+    (bench._metric_tag), so a CPU number is structurally incomparable
+    with a GPU or TPU trajectory point — compare() refuses instead of
+    ratioing across backends."""
+    shape = "HIGGS-class GBDT training throughput (65536 rows)"
+    base = _fresh(metric=shape + " [NVIDIA H100]")
+    probs = cbr.compare(_fresh(metric=shape + " [cpu]", value=1.0),
+                        base)
+    assert len(probs) == 1 and "not comparable" in probs[0]
+    # the refusal names both stamps so a sweep log is self-explaining
+    assert "[cpu]" in probs[0] and "[NVIDIA H100]" in probs[0]
+
+
+def test_metric_tag_matches_device_kind():
+    """The stamp bench.py appends is exactly the autotuner's device
+    kind in brackets — the same value the parity section records, so
+    the metric-string gate and _parity_comparable agree on identity."""
+    sys.path.insert(0, REPO)
+    import bench
+    from lightgbm_tpu.ops import autotune
+    assert bench._metric_tag() == f" [{autotune.device_kind()}]"
+
+
+def test_cli_cross_backend_exit_2_and_walkback(tmp_path):
+    """A fresh CPU run against a trajectory whose NEWEST point was
+    recorded on GPU: baseline selection filters on metric equality, so
+    it walks back past the non-matching-backend point to the newest
+    same-device one and gates there; a fresh run from a backend with
+    no trajectory point at all is refused (exit 2), never ratioed
+    against another device's numbers."""
+    shape = "HIGGS-class GBDT training throughput (65536 rows)"
+    base_dir = tmp_path / "repo"
+    base_dir.mkdir()
+    (base_dir / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": _fresh(metric=shape + " [cpu]", value=49.0)}))
+    (base_dir / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": _fresh(metric=shape + " [NVIDIA H100]",
+                          value=490.0)}))
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_fresh(metric=shape + " [cpu]",
+                                    value=48.0)))
+    # walk-back past the newer GPU point: 48 passes the 49 CPU floor
+    # (against the GPU point it would read as a 10x regression)
+    assert cbr.main([str(ok), "--baseline-dir", str(base_dir)]) == 0
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(_fresh(metric=shape + " [cpu]",
+                                      value=10.0)))
+    # ...and the walked-back point still GATES (exit 1 = regression)
+    assert cbr.main([str(slow), "--baseline-dir", str(base_dir)]) == 1
+    tpu = tmp_path / "tpu.json"
+    tpu.write_text(json.dumps(_fresh(metric=shape + " [TPU v4]",
+                                     value=700.0)))
+    # no TPU point anywhere on the trajectory: refusal, exit 2
+    assert cbr.main([str(tpu), "--baseline-dir", str(base_dir)]) == 2
+
+
 def test_cli_pass_fail_and_exit_codes(tmp_path):
     base_dir = tmp_path / "repo"
     base_dir.mkdir()

@@ -1,0 +1,531 @@
+"""GPU (Pallas-Triton) backend suite (pytest -m gpu_tier).
+
+Three layers lock the GPU port down, all runnable on any backend:
+
+1. **Kernel bit-parity** — the Pallas-GPU histogram kernels
+   (wave_histogram_pallas_gpu, fused_partition_histogram_pallas_gpu)
+   in interpret mode must reproduce the XLA oracles BIT-FOR-BIT across
+   the awkward-numerics grid (-0.0 gradients, out-of-bag rows,
+   categorical bitsets, quantized int8 tier, count-proxy two-channel,
+   odd-feature packed4 nibbles), and the fused forest traversal
+   (forest_predict_pallas_gpu) must match the TPU Pallas kernel's
+   interpret-mode bits at the same row tile plus the host traversal
+   within fp32 tolerance.
+2. **Device-kind autotune arms** — tune_hist_route's capability
+   ladder, the shared-memory candidate guard
+   (gpu_hist_chunk_candidates / gpu_hist_smem_bytes / fits_smem), and
+   tune_hist_chunk's GPU arm driven by an injected fake timer
+   (selection + cache-hit semantics without a physical GPU).
+3. **Per-backend step-cache keying** — WaveGrowerConfig.route rides
+   the compiled-step geometry key: a forced pallas-gpu training run
+   (interpret mode) compiles its OWN step, trains bit-identical trees
+   to the fused-XLA route, and a same-geometry retrain is a pure
+   registry hit.
+
+The whole module skips cleanly (with the capability named in the
+reason) when this jax cannot lower Pallas-Triton — the same gate
+tune_hist_route uses for the pallas-gpu rung.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from conftest import TEST_PARAMS, fit_gbdt, make_binary, make_multiclass
+from lightgbm_tpu.ops import autotune, step_cache
+from lightgbm_tpu.ops import stacked_predict as sp
+from lightgbm_tpu.ops.hist_wave import (
+    TBL_CATW, TBL_ISCAT, fused_partition_histogram_pallas_gpu,
+    fused_partition_histogram_xla, wave_histogram,
+    wave_histogram_pallas_gpu, wave_histogram_xla)
+from lightgbm_tpu.ops.split import FeatureMeta, SplitParams
+from lightgbm_tpu.ops.stacked_predict import StackedModel
+from lightgbm_tpu.ops.wave_grower import WaveGrowerConfig, make_wave_grower
+
+pytestmark = [
+    pytest.mark.gpu_tier,
+    pytest.mark.skipif(
+        not autotune.gpu_pallas_supported(),
+        reason="jax.experimental.pallas.triton not importable — the "
+               "pallas-gpu route is gated off on this install, so the "
+               "interpret-mode parity suite has nothing to certify"),
+]
+
+
+def _jx(*arrs):
+    return tuple(jnp.asarray(a) for a in arrs)
+
+
+def _kernel_problem(kind, N=777, F=6, B=63, n_leaves=5, seed=3):
+    """(bins_t, g, h, leaf) with the grid's awkward numerics (the
+    exact-tier suite's fixture, shared shape)."""
+    r = np.random.default_rng(seed)
+    bins_t = r.integers(0, B, (F, N)).astype(np.uint8)
+    g = r.normal(size=N).astype(np.float32)
+    h = r.uniform(0.2, 1.0, N).astype(np.float32)
+    leaf = r.integers(-1, n_leaves, N).astype(np.int32)
+    if kind == "neg_zero":
+        g[::7] = -0.0
+        g[1::7] = 0.0
+    elif kind == "zero_hess":
+        h[::5] = 0.0
+    elif kind == "bag_heavy":
+        leaf[r.random(N) < 0.6] = -1
+    return bins_t, g, h, leaf
+
+
+KERNEL_KINDS = ["plain", "neg_zero", "zero_hess", "bag_heavy"]
+
+
+def _pack4(bins_t):
+    """Two 4-bit bins per byte, feature 2p in the LOW nibble of byte
+    row p (the _feature_row / _gpu_unpack_row layout); an odd feature
+    count leaves the last high nibble zero."""
+    F, N = bins_t.shape
+    p = np.zeros(((F + 1) // 2, N), np.uint8)
+    for f in range(F):
+        p[f // 2] |= bins_t[f] << (4 * (f % 2))
+    return p
+
+
+# ---------------------------------------------------------------------------
+# 1a. wave histogram kernel bit-parity
+# ---------------------------------------------------------------------------
+
+class TestWaveGpuKernel:
+    @pytest.mark.parametrize("kind", KERNEL_KINDS)
+    def test_bitwise_vs_xla_oracle(self, kind):
+        """f32 channels INCLUDED: the per-row ascending atomic order
+        is the oracle's combined-scatter order, so interpret mode is
+        bit-equal, not merely close."""
+        bins_t, g, h, leaf = _kernel_problem(kind)
+        wl = np.array([0, 2, -1, 4, 1], np.int32)
+        args = _jx(bins_t, g, h, leaf, wl)
+        ref = np.asarray(wave_histogram_xla(*args, num_bins=64))
+        got = np.asarray(wave_histogram_pallas_gpu(
+            *args, num_bins=64, chunk=256, interpret=True))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_variants_are_layout_free(self):
+        """Every hilo layout lowers to the same layout-free GPU kernel
+        (no 128-lane budget to ration) — identical bits across the
+        variant knob, which exists for interface parity only."""
+        bins_t, g, h, leaf = _kernel_problem("plain")
+        wl = np.array([0, 1, 2, 3, 4], np.int32)
+        args = _jx(bins_t, g, h, leaf, wl)
+        outs = [np.asarray(wave_histogram_pallas_gpu(
+            *args, num_bins=64, chunk=256, interpret=True, variant=v))
+            for v in ("hilo5", "hilo4", "hilo3")]
+        np.testing.assert_array_equal(outs[0], outs[1])
+        np.testing.assert_array_equal(outs[0], outs[2])
+
+    def test_int8_tier_raw_wire_and_dequant(self):
+        """Quantized tier: int32 accumulation of integer-valued g/h is
+        exact; dequant=False hands back the quantized-psum wire format
+        and gh_scale dequantizes exactly like the oracle's f32 sums."""
+        bins_t, _, _, leaf = _kernel_problem("bag_heavy")
+        r = np.random.default_rng(9)
+        N = bins_t.shape[1]
+        gq = r.integers(-127, 128, N).astype(np.float32)
+        hq = r.integers(0, 128, N).astype(np.float32)
+        wl = np.array([0, 2, -1, 4, 1], np.int32)
+        args = _jx(bins_t, gq, hq, leaf, wl)
+        ref = np.asarray(wave_histogram_xla(*args, num_bins=64))
+        raw = np.asarray(wave_histogram_pallas_gpu(
+            *args, num_bins=64, chunk=256, interpret=True,
+            precision="int8", dequant=False))
+        assert raw.dtype == np.int32
+        np.testing.assert_array_equal(raw.astype(np.float32), ref)
+        deq = np.asarray(wave_histogram_pallas_gpu(
+            *args, num_bins=64, chunk=256, interpret=True,
+            precision="int8", gh_scale=(0.5, 0.25)))
+        np.testing.assert_array_equal(
+            deq, ref * np.array([0.5, 0.25, 1.0], np.float32))
+
+    def test_count_proxy_two_channel(self):
+        """count_proxy drops the count plane: [W, F, B, 2] of exactly
+        the oracle's g/h channels, dequantized by the 2-vector."""
+        bins_t, _, _, leaf = _kernel_problem("plain")
+        r = np.random.default_rng(10)
+        N = bins_t.shape[1]
+        gq = r.integers(-127, 128, N).astype(np.float32)
+        hq = r.integers(0, 128, N).astype(np.float32)
+        wl = np.array([0, 2, -1, 4, 1], np.int32)
+        args = _jx(bins_t, gq, hq, leaf, wl)
+        ref = np.asarray(wave_histogram_xla(*args, num_bins=64))
+        got = np.asarray(wave_histogram_pallas_gpu(
+            *args, num_bins=64, chunk=256, interpret=True,
+            precision="int8", count_proxy=True, gh_scale=(0.5, 2.0)))
+        assert got.shape == ref[..., :2].shape
+        np.testing.assert_array_equal(
+            got, ref[..., :2] * np.array([0.5, 2.0], np.float32))
+
+    def test_packed4_odd_feature_count(self):
+        """4-bit nibble tier with an ODD logical feature count — the
+        dangling high nibble must not leak into the histogram."""
+        bins_t, g, h, leaf = _kernel_problem("plain", F=5, B=16)
+        wl = np.array([0, 2, -1, 4, 1], np.int32)
+        ref = np.asarray(wave_histogram_xla(
+            *_jx(bins_t, g, h, leaf, wl), num_bins=16))
+        got = np.asarray(wave_histogram_pallas_gpu(
+            *_jx(_pack4(bins_t), g, h, leaf, wl), num_bins=16,
+            chunk=256, interpret=True, packed4=True, num_features=5))
+        np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# 1b. fused partition+histogram kernel bit-parity
+# ---------------------------------------------------------------------------
+
+def _fused_case(categorical=False, B=64, seed=0):
+    """One wave of 4 live splits (+4 inactive slots) with bagging,
+    -0.0 gradients, missing-type metadata; optionally slot 0 becomes a
+    categorical bitset split."""
+    r = np.random.default_rng(seed)
+    N, F, W = 999, 5, 8
+    bins_t = r.integers(0, B - 1, (F, N)).astype(np.uint8)
+    g = r.normal(size=N).astype(np.float32)
+    g[::9] = -0.0
+    h = r.uniform(0.1, 1, N).astype(np.float32)
+    mask = (r.uniform(size=N) > 0.3).astype(np.float32)
+    leaf = r.integers(0, 4, N).astype(np.int32)
+    wl = np.array([0, 1, 2, 3, -1, -1, -1, -1], np.int32)
+    new_ids = np.array([4, 5, 6, 7, -1, -1, -1, -1], np.int32)
+    feat = r.integers(0, F, W).astype(np.int32)
+    tbin = r.integers(0, B - 4, W).astype(np.int32)
+    dleft = r.integers(0, 2, W).astype(bool)
+    meta = FeatureMeta(
+        num_bin=np.full(F, B, np.int32),
+        missing_type=np.array([0, 1, 2, 0, 1], np.int32),
+        default_bin=np.array([0, 3, 0, 0, 5], np.int32),
+        monotone=np.zeros(F, np.int32),
+        penalty=np.ones(F, np.float32))
+    iscat = np.zeros(W, bool)
+    catw = np.zeros((W, 8), np.int32)
+    if categorical:
+        iscat[0] = True
+        bits = r.integers(0, 2, B, dtype=np.int64)
+        for b in np.nonzero(bits)[0]:
+            catw[0, b // 32] |= 1 << (b % 32)
+    tbl = np.zeros((18, W), np.int32)
+    tbl[0], tbl[1], tbl[2], tbl[3] = wl, new_ids, feat, tbin
+    tbl[4] = dleft.astype(np.int32)
+    tbl[5] = meta.missing_type[feat]
+    tbl[6] = meta.default_bin[feat]
+    tbl[7] = meta.num_bin[feat]
+    tbl[8] = new_ids                # small = right child
+    tbl[TBL_ISCAT] = iscat.astype(np.int32)
+    for q in range(8):
+        tbl[TBL_CATW + q] = catw[:, q]
+    oracle_args = (wl, new_ids, feat, tbin, dleft, iscat, catw,
+                   new_ids, meta.missing_type[np.maximum(feat, 0)],
+                   meta.default_bin[np.maximum(feat, 0)],
+                   meta.num_bin[np.maximum(feat, 0)])
+    return bins_t, g, h, mask, leaf, tbl, oracle_args, B
+
+
+class TestFusedGpuKernel:
+    @pytest.mark.parametrize("categorical", [False, True])
+    def test_bitwise_vs_xla_oracle(self, categorical):
+        (bins_t, g, h, mask, leaf, tbl, oargs, B) = _fused_case(
+            categorical)
+        gm, hm = g * mask, h * mask
+        lr, hr = fused_partition_histogram_xla(
+            *_jx(bins_t, gm, hm, mask, leaf, *oargs), num_bins=B)
+        lg, hg = fused_partition_histogram_pallas_gpu(
+            *_jx(bins_t, gm, hm, mask, leaf, tbl), num_bins=B,
+            chunk=256, interpret=True, any_cat=categorical)
+        np.testing.assert_array_equal(np.asarray(lg), np.asarray(lr))
+        np.testing.assert_array_equal(np.asarray(hg), np.asarray(hr))
+
+    def test_int8_count_proxy_with_exact_counts(self):
+        """Quantized proxy tier: 2-channel dequantized histogram plus
+        the EXACT in-bag moved-row counts the partition mask implies."""
+        (bins_t, _, _, mask, leaf, tbl, oargs, B) = _fused_case()
+        r = np.random.default_rng(5)
+        N = bins_t.shape[1]
+        gq = (r.integers(-127, 128, N) * mask).astype(np.float32)
+        hq = (r.integers(0, 128, N) * mask).astype(np.float32)
+        lr, hr, cr = fused_partition_histogram_xla(
+            *_jx(bins_t, gq, hq, mask, leaf, *oargs), num_bins=B,
+            count_proxy=True)
+        lg, hg, cg = fused_partition_histogram_pallas_gpu(
+            *_jx(bins_t, gq, hq, mask, leaf, tbl), num_bins=B,
+            chunk=256, interpret=True, precision="int8",
+            count_proxy=True, gh_scale=(0.5, 0.25))
+        np.testing.assert_array_equal(np.asarray(lg), np.asarray(lr))
+        np.testing.assert_array_equal(
+            np.asarray(hg),
+            np.asarray(hr)[..., :2] * np.array([0.5, 0.25], np.float32))
+        np.testing.assert_array_equal(np.asarray(cg), np.asarray(cr))
+
+    def test_packed4_odd_feature_count(self):
+        (bins_t, g, h, mask, leaf, tbl, oargs, _) = _fused_case(B=16)
+        gm, hm = g * mask, h * mask
+        lr, hr = fused_partition_histogram_xla(
+            *_jx(bins_t, gm, hm, mask, leaf, *oargs), num_bins=16)
+        lg, hg = fused_partition_histogram_pallas_gpu(
+            *_jx(_pack4(bins_t), gm, hm, mask, leaf, tbl), num_bins=16,
+            chunk=256, interpret=True, packed4=True, num_features=5)
+        np.testing.assert_array_equal(np.asarray(lg), np.asarray(lr))
+        np.testing.assert_array_equal(np.asarray(hg), np.asarray(hr))
+
+    def test_dispatcher_pins_gpu_route_off_device(self):
+        """wave_histogram(route='pallas-gpu') on a CPU backend runs the
+        GPU kernel in interpret mode — the dryrun/parity entry point."""
+        bins_t, g, h, leaf = _kernel_problem("plain")
+        wl = np.array([0, 2, -1, 4, 1], np.int32)
+        args = _jx(bins_t, g, h, leaf, wl)
+        ref = np.asarray(wave_histogram_xla(*args, num_bins=64))
+        got = np.asarray(wave_histogram(
+            *args, num_bins=64, route="pallas-gpu"))
+        np.testing.assert_array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# 1c. fused forest traversal bit-parity
+# ---------------------------------------------------------------------------
+
+def _stacked(g):
+    g._ensure_host_trees()
+    sm = StackedModel(g.models, g.max_feature_idx + 1,
+                      g.num_tree_per_iteration)
+    assert sm.ok
+    return sm
+
+
+def _host_raw(g, X):
+    g._ensure_host_trees()
+    k = g.num_tree_per_iteration
+    out = np.zeros((k, X.shape[0]))
+    for t, m in enumerate(g.models):
+        out[t % k] += m.predict(X)
+    return out
+
+
+def _pallas_stacks(sm):
+    dev = sm._device_arrays_pallas(0, sm.num_trees, sm._pallas_tc())
+    return dev, tuple(int(o) for o in sm._offsets)
+
+
+class TestForestGpuKernel:
+    ROW_TILE = 512
+
+    def test_binary_with_nans_bitwise_vs_tpu_interpret(self):
+        """Same row tile, same step order, exact integer decision
+        algebra: the GPU forest kernel's interpret bits equal the TPU
+        Pallas kernel's interpret bits, and both track the host
+        traversal within fp32 tolerance."""
+        X, y = make_binary(n=1200, f=6, seed=47)
+        g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="binary"),
+                     num_round=13)
+        sm = _stacked(g)
+        Xt = np.random.default_rng(11).normal(size=(700, 6))
+        Xt[::9, 1] = np.nan
+        codes = jnp.asarray(np.ascontiguousarray(sm._bin_rows(Xt).T))
+        dev, offs = _pallas_stacks(sm)
+        a = sp.forest_predict_pallas(
+            codes, *dev, offsets=offs, row_tile=self.ROW_TILE,
+            interpret=True)
+        b = sp.forest_predict_pallas_gpu(
+            codes, *dev, offsets=offs, row_tile=self.ROW_TILE,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(b)[:700].T,
+                                   _host_raw(g, Xt), atol=1e-5)
+
+    def test_multiclass_and_from_x_devbin(self):
+        r = np.random.default_rng(51)
+        X = r.normal(size=(1100, 5)).astype(np.float32).astype(
+            np.float64)
+        y = ((np.abs(X[:, 0]) + X[:, 1] > 1).astype(int)
+             + (X[:, 2] > 0)).astype(np.float32)
+        g = fit_gbdt(X, y, dict(TEST_PARAMS, objective="multiclass",
+                                num_class=3), num_round=6)
+        sm = _stacked(g)
+        Xt = r.normal(size=(500, 5))
+        codes = jnp.asarray(np.ascontiguousarray(sm._bin_rows(Xt).T))
+        dev, offs = _pallas_stacks(sm)
+        a = sp.forest_predict_pallas(
+            codes, *dev, offsets=offs, row_tile=self.ROW_TILE,
+            interpret=True)
+        b = sp.forest_predict_pallas_gpu(
+            codes, *dev, offsets=offs, row_tile=self.ROW_TILE,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # device-binning twin: float rows in, same bits out
+        assert sm._dev_bin_ok
+        aux = (jnp.asarray(sm._E_f32), jnp.asarray(sm._off32),
+               jnp.asarray(sm._nan_slot))
+        xf = jnp.asarray(Xt.astype(np.float32))
+        c = sp.forest_predict_from_x(
+            xf, *aux, *dev, offsets=offs, row_tile=self.ROW_TILE,
+            interpret=True)
+        d = sp.forest_predict_from_x_gpu(
+            xf, *aux, *dev, offsets=offs, row_tile=self.ROW_TILE,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+
+
+# ---------------------------------------------------------------------------
+# 2. device-kind autotune arms
+# ---------------------------------------------------------------------------
+
+class TestGpuAutotuneArms:
+    @pytest.fixture
+    def fresh_tuner(self, tmp_path):
+        autotune.configure("on", str(tmp_path / "tuning.json"))
+        yield
+        autotune.configure("on", None)
+
+    def test_tune_hist_route_capability_ladder(self):
+        assert autotune.tune_hist_route(backend="tpu") == "pallas-tpu"
+        assert autotune.tune_hist_route(backend="gpu") == "pallas-gpu"
+        assert autotune.tune_hist_route(backend="cpu") == "fused-xla"
+        assert autotune.tune_hist_route(
+            backend="cpu", fused_eligible=False) == "two-pass"
+        # config override beats capability, both directions
+        assert autotune.tune_hist_route(
+            backend="gpu", use_pallas=False) == "fused-xla"
+        assert autotune.tune_hist_route(
+            backend="cpu", use_pallas=True) == "pallas-tpu"
+
+    def test_candidates_respect_smem_budget(self):
+        geom = autotune.hist_geometry(F=8, B=64, W=8, F_rows=8)
+        cands = autotune.gpu_hist_chunk_candidates(F=8, B=64, W=8,
+                                                   fused=False)
+        assert cands, "small geometry must admit at least one tile"
+        chunks = [c["chunk"] for c in cands]
+        assert chunks == sorted(chunks, reverse=True), "largest-first"
+        for c in chunks:
+            assert autotune.fits_smem(autotune.gpu_hist_smem_bytes(
+                chunk=c, geom=geom, fused=False))
+        # pricing is monotone in the tile, and the fused kernel's
+        # extra per-row operands (mask, leaf in/out, table) cost more
+        b1 = autotune.gpu_hist_smem_bytes(chunk=512, geom=geom,
+                                          fused=False)
+        b2 = autotune.gpu_hist_smem_bytes(chunk=1024, geom=geom,
+                                          fused=False)
+        bf = autotune.gpu_hist_smem_bytes(chunk=512, geom=geom,
+                                          fused=True)
+        assert b1 < b2 and bf > b1
+
+    def test_candidates_cap_at_n_rows(self):
+        chunks = [c["chunk"] for c in autotune.gpu_hist_chunk_candidates(
+            F=8, B=64, W=8, fused=False, n_rows=300)]
+        assert chunks == [256]
+
+    def test_exhaustive_superset(self):
+        norm = {c["chunk"] for c in autotune.gpu_hist_chunk_candidates(
+            F=8, B=64, W=8, fused=False)}
+        exh = {c["chunk"] for c in autotune.gpu_hist_chunk_candidates(
+            F=8, B=64, W=8, fused=False, exhaustive=True)}
+        assert norm <= exh and len(exh) > len(norm)
+
+    def test_gpu_arm_fake_timer_selection_and_cache(self, fresh_tuner):
+        """The GPU arm engages off-TPU whenever a timer is injected:
+        the fastest shared-memory-feasible tile wins, and the second
+        encounter of the (kernel, geometry, device) key times nothing."""
+        calls = []
+
+        def fake(cand):
+            calls.append(cand["chunk"])
+            return {256: 2.0, 512: 0.5, 1024: 1.0, 2048: 3.0}.get(
+                cand["chunk"], 9.0)
+
+        got = autotune.tune_hist_chunk(fused=False, F=8, B=64, W=8,
+                                       _measure=fake)
+        assert got == 512
+        assert set(calls) == {c["chunk"] for c in
+                              autotune.gpu_hist_chunk_candidates(
+                                  F=8, B=64, W=8, fused=False)}
+        calls.clear()
+        again = autotune.tune_hist_chunk(fused=False, F=8, B=64, W=8,
+                                         _measure=fake)
+        assert again == 512
+        assert calls == [], "second encounter must be a cache hit"
+        # the fused kernel tunes under its own name — same geometry,
+        # fresh timing run, no collision with the wave decision
+        fused_choice = autotune.tune_hist_chunk(fused=True, F=8, B=64,
+                                                W=8, _measure=fake)
+        assert calls, "fused arm must not reuse the wave cache entry"
+        assert fused_choice == 512
+
+    def test_cpu_backend_without_timer_keeps_default(self, fresh_tuner):
+        assert autotune.tune_hist_chunk(
+            fused=False, F=8, B=64, W=8) == autotune.DEFAULT_HIST_CHUNK
+
+    def test_sparse_tier_ceiling_is_lower_on_gpu(self):
+        """On the gpu route the sparse tier forfeits the fused Pallas
+        kernel, so auto demands a sparser matrix than elsewhere."""
+        kw = dict(requested=-1, nnz=1000, F=8, B=64, W=8, quant=True)
+        mid = (autotune.SPARSE_TIER_MAX_DENSITY
+               + autotune.SPARSE_TIER_MAX_DENSITY_GPU) / 2
+        assert autotune.tune_hist_tier(density=mid, backend="cpu", **kw)
+        assert not autotune.tune_hist_tier(density=mid, backend="gpu",
+                                           **kw)
+        assert autotune.tune_hist_tier(
+            density=autotune.SPARSE_TIER_MAX_DENSITY_GPU / 2,
+            backend="gpu", **kw)
+
+
+# ---------------------------------------------------------------------------
+# 3. per-backend step-cache keying
+# ---------------------------------------------------------------------------
+
+def _trees(g):
+    return g.model_to_string().split("parameters:")[0]
+
+
+def _stats_delta(fn):
+    s0 = step_cache.stats()
+    out = fn()
+    s1 = step_cache.stats()
+    return out, {k: s1[k] - s0[k] for k in ("hits", "misses")}
+
+
+class TestStepCacheKeying:
+    def test_route_field_separates_config_identity(self):
+        kw = dict(num_leaves=15, num_bins=63, wave_size=8,
+                  hp=SplitParams())
+        a = WaveGrowerConfig(**kw, route="fused-xla")
+        b = WaveGrowerConfig(**kw, route="pallas-gpu")
+        assert a != b and hash(a) != hash(b)
+
+    def test_bogus_route_rejected(self):
+        meta = FeatureMeta(
+            num_bin=np.full(4, 63, np.int32),
+            missing_type=np.zeros(4, np.int32),
+            default_bin=np.zeros(4, np.int32),
+            monotone=np.zeros(4, np.int32),
+            penalty=np.ones(4, np.float32))
+        cfg = WaveGrowerConfig(num_leaves=15, num_bins=63, wave_size=8,
+                               hp=SplitParams(), route="pallas-rocm")
+        with pytest.raises(ValueError, match="route"):
+            make_wave_grower(cfg, meta)
+
+    def test_gpu_route_trains_bit_identical_and_keys_apart(
+            self, monkeypatch):
+        """Force the pallas-gpu route on this CPU host (interpret
+        mode): the model is BIT-identical to the fused-XLA route's, the
+        first GPU-route booster compiles its own step (registry miss —
+        the route rides the geometry key), and a same-geometry
+        GPU-route retrain is a pure registry hit."""
+        X, y = make_binary(640, seed=21)
+        params = dict(TEST_PARAMS, objective="binary")
+        g_cpu, _ = _stats_delta(
+            lambda: fit_gbdt(X, y, params, num_round=4))
+        monkeypatch.setattr(autotune, "tune_hist_route",
+                            lambda **kw: "pallas-gpu")
+        g_gpu1, d1 = _stats_delta(
+            lambda: fit_gbdt(X, y, params, num_round=4))
+        g_gpu2, d2 = _stats_delta(
+            lambda: fit_gbdt(X, y, params, num_round=4))
+        assert _trees(g_gpu1) == _trees(g_cpu), \
+            "pallas-gpu interpret route must reproduce the fused-XLA " \
+            "route's trees bit-for-bit"
+        assert d1["misses"] >= 1, \
+            "the GPU route must compile its own step program"
+        assert d2["misses"] == 0 and d2["hits"] >= 1, \
+            "same-geometry GPU-route retrain must be a registry hit"
+        assert _trees(g_gpu2) == _trees(g_gpu1)

@@ -32,10 +32,15 @@ the numbers. This tool makes the comparison mechanical:
   (compliance on a shared host is an operator signal, not a perf
   regression);
 - **comparability**: the bench ``metric`` string embeds the workload
-  shape (rows x features, leaves, bins, iters, chips) — a quick run is
-  refused against a full-size baseline instead of "passing" a
-  meaningless comparison (``--schema-only`` skips the trajectory and
-  just validates the fresh artifact's shape, including the
+  shape (rows x features, leaves, bins, iters, chips) AND the device
+  kind (bench.py ``_metric_tag`` — a trailing ``[cpu]`` / ``[TPU v4]``
+  / GPU-name stamp) — a quick run is refused against a full-size
+  baseline, and a CPU number against a GPU or TPU trajectory (exit 2),
+  instead of "passing" a meaningless comparison. Baseline selection
+  filters on metric equality, so the walk-back skips trajectory
+  points recorded on a different backend and gates against the newest
+  same-shape same-device point (``--schema-only`` skips the trajectory
+  and just validates the fresh artifact's shape, including the
   predict-latency quantiles).
 
 Standalone:  ``python tools/check_bench_regression.py fresh.json``
