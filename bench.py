@@ -219,6 +219,210 @@ def lrb_stream_bench(args) -> dict:
     return stream
 
 
+FLEET_FEATURES = 16
+
+
+def _fleet_model_str(rows: int, iters: int) -> str:
+    """Train one small binary booster through the capi surface and
+    return its model text — the artifact every fleet tenant is
+    registered from. Same text, same tree geometry: the predict
+    registry compiles ONE program and serves all K tenants off it."""
+    from lightgbm_tpu import capi
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(rows, FLEET_FEATURES))
+    y = (X[:, 0] + 0.5 * X[:, 1]
+         + 0.25 * rng.normal(size=rows) > 0).astype(np.float64)
+    ds = capi.LGBM_DatasetCreateFromMat(X)
+    capi.LGBM_DatasetSetField(ds, "label", y)
+    booster = capi.LGBM_BoosterCreate(
+        ds, {"objective": "binary", "num_leaves": 31, "verbose": "-1"})
+    for _ in range(iters):
+        capi.LGBM_BoosterUpdateOneIter(booster)
+    return capi.LGBM_BoosterSaveModelToString(booster)
+
+
+def fleet_bench(args) -> dict:
+    """The multi-tenant coalesced-serving bench (serve/): one
+    ScoringDaemon, K same-geometry tenants registered from ONE model
+    text, scored over real localhost HTTP in two phases —
+
+      sequential   each tenant's requests issued one at a time, one
+                   tenant after another: no concurrency, so the
+                   coalescer never merges anything (the K-separate-
+                   processes fleet this subsystem replaces)
+      coalesced    K paced client threads offered ~2x the sequential
+                   phase's per-tenant rate (the lrb-stream feeder's
+                   burst-paced clock-rebase loop), so requests from
+                   different tenants genuinely overlap and the
+                   dispatcher drains them as shared device batches
+
+    Reported: aggregate requests/s for both phases, per-tenant client
+    p50/p99, the coalesced-batch-rows histogram, the predict-registry
+    hit rate across registration + serving (K-1 of K registrations
+    reuse the first tenant's compiled program), shed/queue-reject
+    counters, and the daemon's admission budget state."""
+    import threading
+    import time as _time
+
+    from lightgbm_tpu.obs import registry as obs_registry
+    from lightgbm_tpu.ops import predict_cache
+    from lightgbm_tpu.serve import FleetClient, ScoringDaemon, ShedError
+    from lightgbm_tpu.serve import coalescer as serve_coalescer
+
+    tenants = max(args.fleet_tenants, 1)
+    reqs = max(args.fleet_requests, 8)
+    rows = max(args.fleet_rows, 1)
+    streams = max(args.fleet_streams, 1)
+    if args.quick:
+        reqs = min(reqs, 80)
+    names = [f"tenant_{i:02d}" for i in range(tenants)]
+    # a deliberately non-trivial forest: per-batch predict dispatch is
+    # the cost coalescing amortizes, so a toy model would measure only
+    # fixed HTTP overhead (not clamped under --quick for the same
+    # reason)
+    model_str = _fleet_model_str(rows=2048, iters=args.fleet_iters)
+    X = np.random.default_rng(29).normal(size=(rows, FLEET_FEATURES))
+
+    before = predict_cache.stats()
+    retries0 = obs_registry.counter("retry/retries").value
+    daemon = ScoringDaemon(port=0, coalesce_us=args.fleet_coalesce_us,
+                           slo_p99_ms=args.fleet_slo_p99_ms).start()
+    try:
+        client = FleetClient(daemon.url)
+        for t in names:
+            client.register(t, model_str, warm_rows=rows)
+        # one warm request per tenant over the wire so neither timed
+        # phase carries a first-request cost the other skipped
+        for t in names:
+            client.predict(t, X)
+
+        # phase 1: uncoalesced sequential streams
+        t0 = _time.monotonic()
+        for t in names:
+            for _ in range(reqs):
+                client.predict(t, X)
+        wall_seq = _time.monotonic() - t0
+        seq_rps = tenants * reqs / max(wall_seq, 1e-9)
+
+        # phase 2: K tenants x M concurrent paced streams, offered 2x
+        # the sequential per-tenant rate in aggregate — sustained only
+        # if coalescing actually buys overlapping requests a shared
+        # device batch. M > 1 puts several same-tenant requests in
+        # flight at once, so the dispatcher gets real merges (one
+        # synchronous stream per tenant would cap every coalesced
+        # batch at a single request).
+        per_stream = max(reqs // streams, 1)
+        per_rate = 2.0 * seq_rps / tenants
+        gap8 = 8.0 * streams / per_rate if per_rate > 0 else 0.0
+        lat = {t: [] for t in names}
+        shed = {t: 0 for t in names}
+        errors = []
+        agg_hist = obs_registry.latency_histogram(
+            "fleet/client_latency_s")
+
+        def stream(t):
+            c = FleetClient(daemon.url)
+            nxt = _time.monotonic()
+            for i in range(per_stream):
+                if gap8 and i % 8 == 0:
+                    nxt += gap8
+                    delay = nxt - _time.monotonic()
+                    if delay > 0:
+                        _time.sleep(delay)
+                    else:
+                        nxt = _time.monotonic()
+                s = _time.monotonic()
+                try:
+                    c.predict(t, X)
+                except ShedError:
+                    shed[t] += 1
+                    continue
+                except Exception as e:  # noqa: BLE001 — a failed
+                    # request is a result (errors gate below), not a
+                    # bench abort
+                    errors.append(f"{t}: {e}")
+                    continue
+                dt = _time.monotonic() - s
+                lat[t].append(dt)       # list.append: thread-safe
+                agg_hist.observe(dt)
+
+        threads = [threading.Thread(target=stream, args=(t,),
+                                    name=f"fleet-{t}-{j}", daemon=True)
+                   for t in names for j in range(streams)]
+        t0 = _time.monotonic()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = _time.monotonic() - t0
+        done = sum(len(v) for v in lat.values())
+        rps = done / max(wall, 1e-9)
+
+        cache = predict_cache.stats()
+        lookups = ((cache["hits"] - before["hits"])
+                   + (cache["misses"] - before["misses"]))
+        hit_rate = ((cache["hits"] - before["hits"]) / lookups
+                    if lookups else None)
+        batch_hist = obs_registry.histogram(
+            "fleet/coalesced_batch_rows",
+            serve_coalescer.ROW_BUCKETS).snapshot()
+        stats = daemon.stats()
+
+        def q_ms(vals, q):
+            return (round(1e3 * float(np.percentile(vals, q)), 3)
+                    if vals else None)
+
+        out = {
+            "tenants": tenants,
+            "requests_per_tenant": per_stream * streams,
+            "rows_per_request": rows, "streams_per_tenant": streams,
+            "coalesce_us": args.fleet_coalesce_us,
+            "requests_per_s": round(rps, 1),
+            "requests_per_s_sequential": round(seq_rps, 1),
+            "coalescing_speedup": round(rps / max(seq_rps, 1e-9), 3),
+            "offered_per_tenant_requests_per_s": round(per_rate, 1),
+            "per_tenant": {
+                t: {"requests": len(lat[t]),
+                    "p50_ms": q_ms(lat[t], 50),
+                    "p99_ms": q_ms(lat[t], 99),
+                    "shed": shed[t]}
+                for t in names},
+            "registry_hit_rate": (round(hit_rate, 4)
+                                  if hit_rate is not None else None),
+            "registry_lookups": lookups,
+            "coalesced_batch_rows": {
+                "batches": batch_hist["count"],
+                "mean": (round(batch_hist["sum"]
+                               / batch_hist["count"], 2)
+                         if batch_hist["count"] else None),
+                "p50": batch_hist["p50"], "p99": batch_hist["p99"],
+                "buckets": batch_hist["buckets"]},
+            "shed_total": stats["shed_total"],
+            "queue_rejects": stats["queue_rejects"],
+            "requests_total": stats["requests_total"],
+            "client_retries": (obs_registry.counter(
+                "retry/retries").value - retries0),
+            "errors": len(errors),
+            "slo_admission": daemon.slo_report(),
+        }
+    finally:
+        daemon.stop()
+    if errors:
+        print(f"# fleet: {len(errors)} failed requests, first: "
+              f"{errors[0]}", file=sys.stderr)
+    worst = max((v["p99_ms"] or 0.0)
+                for v in out["per_tenant"].values())
+    print(f"# fleet: {tenants} tenants x {reqs} requests — "
+          f"{out['requests_per_s']:.0f} requests/s coalesced vs "
+          f"{out['requests_per_s_sequential']:.0f} sequential "
+          f"({out['coalescing_speedup']:.2f}x), worst-tenant p99 "
+          f"{worst} ms, mean batch "
+          f"{out['coalesced_batch_rows']['mean']} rows, registry hit "
+          f"rate {out['registry_hit_rate']}, shed {out['shed_total']}",
+          file=sys.stderr)
+    return out
+
+
 def make_ctr_sparse(n_rows: int, n_features: int, density: float,
                     seed: int = 11):
     """Synthetic CTR-shaped sparse task: ~density*F active hashed
@@ -555,6 +759,12 @@ def rank_bench(args) -> dict:
 DEFAULT_SLO_TRAIN = "predict_p99_ms<5000;degraded_window_rate<0.5"
 DEFAULT_SLO_STREAM = ("serve_p99_ms<5000;staleness_windows<=8;"
                       "degraded_window_rate<0.5")
+# fleet bench: client-observed wire latency (generic hist form,
+# threshold in seconds) + a ceiling on how much of the offered load
+# admission control may shed before the artifact flags itself
+DEFAULT_SLO_FLEET = ("hist:fleet/client_latency_s:p99 < 5;"
+                     "ratio:fleet/shed_total|fleet/requests_total"
+                     " <= 0.5")
 
 
 def slo_section(spec: str) -> dict:
@@ -873,6 +1083,37 @@ def main():
     ap.add_argument("--lrb-sample", type=int, default=512)
     ap.add_argument("--lrb-iters", type=int, default=10)
     ap.add_argument("--lrb-serve-batch", type=int, default=32)
+    ap.add_argument("--fleet", action="store_true",
+                    help="run ONLY the multi-tenant coalesced-serving "
+                         "bench (serve/): one scoring daemon, K "
+                         "same-geometry tenants over localhost HTTP, "
+                         "sequential uncoalesced streams vs K paced "
+                         "concurrent streams; emits a standalone JSON "
+                         "line (unit requests/s, details under "
+                         "'fleet')")
+    ap.add_argument("--fleet-tenants", type=int, default=4)
+    ap.add_argument("--fleet-requests", type=int, default=300,
+                    help="requests per tenant per phase (default 300;"
+                         " --quick clamps to 80)")
+    ap.add_argument("--fleet-rows", type=int, default=4,
+                    help="rows per request (default 4 — the "
+                         "small-batch shape coalescing exists for)")
+    ap.add_argument("--fleet-iters", type=int, default=150,
+                    help="boosting rounds for the shared fleet model "
+                         "(default 150 — big enough that per-batch "
+                         "predict dispatch, the cost coalescing "
+                         "amortizes, dominates fixed HTTP overhead)")
+    ap.add_argument("--fleet-streams", type=int, default=2,
+                    help="concurrent client streams per tenant in the "
+                         "coalesced phase (default 2: several "
+                         "same-tenant requests in flight is what "
+                         "makes per-tick merging visible)")
+    ap.add_argument("--fleet-coalesce-us", type=int, default=2000,
+                    help="coalescer max-wait (tpu_fleet_coalesce_us)")
+    ap.add_argument("--fleet-slo-p99-ms", type=float, default=250.0,
+                    help="per-tenant p99 admission threshold for the "
+                         "bench daemon (tpu_fleet_slo_p99_ms); 0 "
+                         "disables shedding")
     ap.add_argument("--slo", default="",
                     help="SLO spec string (obs/slo.py grammar) for the "
                          "JSON line's 'slo' section — budget remaining, "
@@ -987,6 +1228,23 @@ def main():
                        f"{sparse['iters']} iters)" + _metric_tag()),
             "value": sparse["routes"]["csr"]["rows_per_s"],
             "unit": "rows/s",
+        }))
+        return
+
+    if args.fleet:
+        from lightgbm_tpu.ops import autotune as _autotune
+        _autotune.ensure_compile_cache()
+        fleet = fleet_bench(args)
+        print(json.dumps({
+            "fleet": fleet,
+            "slo": slo_section(args.slo or DEFAULT_SLO_FLEET),
+            "metric": ("fleet coalesced serving "
+                       f"({fleet['tenants']} tenants x "
+                       f"{fleet['requests_per_tenant']} requests, "
+                       f"{fleet['rows_per_request']}-row requests)"
+                       + _metric_tag()),
+            "value": fleet["requests_per_s"],
+            "unit": "requests/s",
         }))
         return
 

@@ -566,6 +566,49 @@ class Config:
     # gauges, the /healthz + /slo endpoint bodies, and — on budget
     # exhaustion — a flight-recorder trigger. Empty = no SLOs.
     tpu_slo: str = ""
+    # fleet scoring daemon (serve/daemon.py): TCP port for the
+    # multi-tenant HTTP scoring endpoint on 127.0.0.1. 0 = ephemeral
+    # (the OS picks; ScoringDaemon.http_port reports the bound port —
+    # the tests' and lrb --serve-daemon's mode). The daemon only
+    # starts when explicitly constructed (bench --fleet, lrb
+    # --serve-daemon, or embedding code); this knob never opens a
+    # socket by itself.
+    tpu_fleet_port: int = 0
+    # cross-request coalescer max wait in MICROSECONDS
+    # (serve/coalescer.py): after the first request of a tick arrives,
+    # the dispatcher lingers up to this long for more requests to
+    # merge into the same pow2-bucketed device batch. Higher = bigger
+    # batches (throughput), at up to this much added p50 latency.
+    # Clamped to [0, 1e6]; 0 = dispatch immediately (coalescing only
+    # what is already queued).
+    tpu_fleet_coalesce_us: int = 2000
+    # max coalesced rows dispatched per tenant per tick
+    # (serve/coalescer.py). Requests beyond the cap stay queued for
+    # the next tick, bounding both device-batch width and the
+    # head-of-line latency a huge batch inflicts on neighbors.
+    # Floor 1.
+    tpu_fleet_max_batch: int = 4096
+    # bounded coalescer admission queue, in REQUESTS
+    # (serve/coalescer.py): submissions beyond this depth are refused
+    # (HTTP 503 + Retry-After) instead of growing an unbounded buffer
+    # — backpressure reaches the client as a retryable signal.
+    # Floor 1.
+    tpu_fleet_queue: int = 1024
+    # per-tenant serving SLO: p99 latency target in MILLISECONDS for
+    # the admission controller (serve/daemon.py). For every registered
+    # tenant the daemon arms a "hist:fleet/tenant_latency_s/<t>:p99 <
+    # target" spec on an obs/slo.py engine and sheds that tenant's
+    # load when its error budget burns low (see
+    # tpu_fleet_shed_budget). 0 = no admission SLO (never shed).
+    # Negative values clamp to 0.
+    tpu_fleet_slo_p99_ms: float = 0.0
+    # admission-control shed threshold (serve/daemon.py): when a
+    # tenant's remaining p99 error budget (obs/slo.py
+    # budget_remaining, 1.0 = untouched, <= 0 = breached) falls to or
+    # below this fraction, the daemon 429s that tenant's requests
+    # BEFORE the budget exhausts, while other tenants keep serving.
+    # Clamped to [0, 1]; 0 = shed only at breach.
+    tpu_fleet_shed_budget: float = 0.25
     # flight recorder ring capacity (obs/flight.py): recent spans, log
     # lines and reqlog records kept in memory and dumped as ONE
     # postmortem JSON bundle when the watchdog fires, a fault
@@ -1030,6 +1073,32 @@ class Config:
                         "clamping", self.tpu_reqlog_sample)
             self.tpu_reqlog_sample = min(
                 max(self.tpu_reqlog_sample, 0.0), 1.0)
+        if not 0 <= self.tpu_fleet_port <= 65535:
+            log.warning("tpu_fleet_port=%d is not a port; using an "
+                        "ephemeral port (0)", self.tpu_fleet_port)
+            self.tpu_fleet_port = 0
+        if not 0 <= self.tpu_fleet_coalesce_us <= 1_000_000:
+            log.warning("tpu_fleet_coalesce_us=%d is outside [0, 1e6]; "
+                        "clamping", self.tpu_fleet_coalesce_us)
+            self.tpu_fleet_coalesce_us = min(
+                max(self.tpu_fleet_coalesce_us, 0), 1_000_000)
+        if self.tpu_fleet_max_batch < 1:
+            log.warning("tpu_fleet_max_batch=%d is below the floor; "
+                        "using 1", self.tpu_fleet_max_batch)
+            self.tpu_fleet_max_batch = 1
+        if self.tpu_fleet_queue < 1:
+            log.warning("tpu_fleet_queue=%d is below the floor; "
+                        "using 1", self.tpu_fleet_queue)
+            self.tpu_fleet_queue = 1
+        if self.tpu_fleet_slo_p99_ms < 0:
+            log.warning("tpu_fleet_slo_p99_ms=%g is negative; disabling "
+                        "the admission SLO (0)", self.tpu_fleet_slo_p99_ms)
+            self.tpu_fleet_slo_p99_ms = 0.0
+        if not 0.0 <= self.tpu_fleet_shed_budget <= 1.0:
+            log.warning("tpu_fleet_shed_budget=%g is outside [0, 1]; "
+                        "clamping", self.tpu_fleet_shed_budget)
+            self.tpu_fleet_shed_budget = min(
+                max(self.tpu_fleet_shed_budget, 0.0), 1.0)
         if self.tpu_flight_buffer < 0:
             log.warning("tpu_flight_buffer=%d is negative; disabling "
                         "the flight recorder (0)", self.tpu_flight_buffer)

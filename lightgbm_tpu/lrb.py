@@ -131,7 +131,8 @@ class LrbDriver:
                  result_file=sys.stdout, seed: int = 0,
                  extra_params: Optional[dict] = None,
                  serve_batch: int = 64,
-                 window_budget_s: Optional[float] = None):
+                 window_budget_s: Optional[float] = None,
+                 serve_daemon: bool = False):
         self.cache_size = cache_size
         self.window_size = window_size
         self.sample_size = sample_size
@@ -232,6 +233,26 @@ class LrbDriver:
         # degraded-window trigger produced them — the rate limiter
         # coalesces one incident into one bundle)
         self._flight_dumps_at_init = len(obs_flight.dump_paths())
+        # --serve-daemon: score every window's requests through the
+        # fleet scoring daemon (serve/) over localhost HTTP instead of
+        # in-process capi predict — each published model is registered
+        # as a new version of the one "lrb" tenant (warm atomic swap
+        # on the daemon side). Degrade, don't die: a daemon that
+        # cannot bind (or a request that fails past the retry policy)
+        # falls back to in-process scoring.
+        self._fleet_daemon = None
+        self._fleet_client = None
+        self._fleet_warned = 0
+        if serve_daemon:
+            from .serve import FleetClient
+            from .serve.daemon import ScoringDaemon
+            try:
+                self._fleet_daemon = ScoringDaemon.from_config(
+                    self.params).start()
+                self._fleet_client = FleetClient(self._fleet_daemon.url)
+            except RuntimeError as e:
+                log.warning("serve-daemon unavailable (%s); scoring "
+                            "in-process", e)
 
     def _make_ring(self):
         """Device-resident ingest chunk ring (io/ingest.py ChunkRing)
@@ -379,6 +400,7 @@ class LrbDriver:
                     labels, X, self.window_index)
                 if handle is not None:
                     self.booster = handle
+                    self._daemon_register(handle, self.window_index)
                 self._apply_train_outcome(rec, stats, reason)
             rec.update(self._opt_ratios())
         self._results.append(rec)
@@ -836,6 +858,50 @@ class LrbDriver:
             self._serving = handle
         obs.counter("lrb/model_swaps").add(1)
         trace.instant("lrb/swap", cat="window", args={"window": widx})
+        self._daemon_register(handle, widx)
+
+    def _daemon_register(self, handle, widx: int) -> None:
+        """--serve-daemon twin of the in-process swap: republish the
+        freshly trained model as the next version of the daemon's
+        "lrb" tenant (serve/tenants.py warms it before the atomic
+        publish; in-flight daemon requests finish on the old
+        version). A failed registration keeps the previous daemon
+        version serving — same degrade-don't-die rule as training."""
+        if self._fleet_client is None:
+            return
+        try:
+            version = self._fleet_client.register(
+                "lrb", capi.LGBM_BoosterSaveModelToString(handle),
+                warm_rows=self.serve_batch)
+            trace.instant("lrb/daemon_swap", cat="window",
+                          args={"window": widx, "version": version})
+        except Exception as e:  # noqa: BLE001 — never kill the loop
+            # over the serving sidecar; the old version keeps serving
+            log.warning("window %d: serve-daemon registration failed "
+                        "(%s); daemon serves the previous version",
+                        widx, e)
+
+    _FLEET_WARN_CAP = 5
+
+    def _daemon_score(self, Xb: np.ndarray) -> Optional[np.ndarray]:
+        """Score one micro-batch through the fleet daemon client
+        (--serve-daemon); None when the mode is off or the request
+        failed past the client's retry policy — the caller falls back
+        to in-process predict for that batch."""
+        if self._fleet_client is None:
+            return None
+        try:
+            return self._fleet_client.predict("lrb", Xb)
+        except Exception as e:  # noqa: BLE001 — a dead sidecar must
+            # degrade to in-process scoring, not kill the loop
+            self._fleet_warned += 1
+            if self._fleet_warned <= self._FLEET_WARN_CAP:
+                log.warning("serve-daemon predict failed (%s); scoring "
+                            "this batch in-process", e)
+            elif self._fleet_warned == self._FLEET_WARN_CAP + 1:
+                log.warning("further serve-daemon predict warnings "
+                            "suppressed")
+            return None
 
     def _join_pending(self) -> None:
         with self._join_lock:
@@ -893,6 +959,10 @@ class LrbDriver:
             if ex is not None:
                 ex.shutdown(wait=True)
                 setattr(self, attr, None)
+        if self._fleet_daemon is not None:
+            self._fleet_daemon.stop()
+            self._fleet_daemon = None
+            self._fleet_client = None
 
     # result-record fields replicated onto the per-window wide event
     # (the flight recorder and the reqlog file both see the window's
@@ -1058,9 +1128,12 @@ class LrbDriver:
             with reqlog.request(rid, window=window) as rctx, \
                     trace.span("serve/request", cat="serve",
                                args=span_args):
-                parts.append(np.asarray(capi.LGBM_BoosterPredictForMat(
-                    h, X[r0:r0 + b],
-                    predict_type=capi.C_API_PREDICT_NORMAL)))
+                preds_b = self._daemon_score(X[r0:r0 + b])
+                if preds_b is None:
+                    preds_b = np.asarray(capi.LGBM_BoosterPredictForMat(
+                        h, X[r0:r0 + b],
+                        predict_type=capi.C_API_PREDICT_NORMAL))
+                parts.append(preds_b)
             dt = time.monotonic() - t0
             self._serve_batch_hist.observe(dt)
             global_batch.observe(dt)
@@ -1097,14 +1170,16 @@ def run_trace_file(path: str, cache_size: int, window_size: int,
                    sample_size: int, cutoff: float, sampling: int,
                    result_file=sys.stdout,
                    extra_params: Optional[dict] = None,
-                   window_budget_s: Optional[float] = None) -> LrbDriver:
+                   window_budget_s: Optional[float] = None,
+                   serve_daemon: bool = False) -> LrbDriver:
     """Drive the loop from a trace file. Malformed lines are SKIPPED
     with a warning carrying the line number (capped at
     ``_MALFORMED_WARN_CAP`` detail lines + a total-skipped summary) —
     one bad record in a multi-day trace must not kill the run."""
     driver = LrbDriver(cache_size, window_size, sample_size, cutoff,
                        sampling, result_file, extra_params=extra_params,
-                       window_budget_s=window_budget_s)
+                       window_budget_s=window_budget_s,
+                       serve_daemon=serve_daemon)
     seq = 0
     skipped = 0
     with open(path) as fh:
@@ -1150,12 +1225,13 @@ def synthetic_trace(n_requests: int, n_objects: int = 200,
         yield i + 1, int(oid), int(sizes[oid]), 1.0
 
 
-def _run_main(argv, out) -> None:
+def _run_main(argv, out, serve_daemon: bool = False) -> None:
     trace_path, cache_size, window_size, sample_size, cutoff, sampling = \
         argv[0], int(argv[1]), int(argv[2]), int(argv[3]), \
         float(argv[4]), int(argv[5])
     driver = run_trace_file(trace_path, cache_size, window_size,
-                            sample_size, cutoff, sampling, out)
+                            sample_size, cutoff, sampling, out,
+                            serve_daemon=serve_daemon)
     driver.close()
     q = driver.window_wall_quantiles()
     if q:
@@ -1181,18 +1257,23 @@ def _run_main(argv, out) -> None:
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
+    # the one optional flag rides alongside the reference's positional
+    # CLI: strip it before the positional parse
+    serve_daemon = "--serve-daemon" in argv
+    argv = [a for a in argv if a != "--serve-daemon"]
     if len(argv) < 6:
         print("parameters: tracePath cacheSize windowSize sampleSize "
-              "cutoff sampling [resultFile]", file=sys.stderr)
+              "cutoff sampling [resultFile] [--serve-daemon]",
+              file=sys.stderr)
         sys.exit(1)
     if len(argv) > 6:
         # context-managed: a crash mid-run must not strand buffered
         # tail windows in a never-closed handle (the driver also
         # flushes after every finished window)
         with open(argv[6], "w") as out:
-            _run_main(argv, out)
+            _run_main(argv, out, serve_daemon)
     else:
-        _run_main(argv, sys.stdout)
+        _run_main(argv, sys.stdout, serve_daemon)
 
 
 if __name__ == "__main__":

@@ -1950,6 +1950,15 @@ class GBDT:
             return False
         if warm_rows > 0:
             sm.warmup(warm_rows)
+            if self.objective is not None:
+                # warm the FULL wire path, not just raw scores: the
+                # objective transform compiles per serve bucket too
+                # (see predict), and a live request stream must never
+                # pay that trace — the fleet daemon registers models
+                # through here (serve/tenants.py)
+                self.predict(np.zeros((int(warm_rows),
+                                       max(self.max_feature_idx + 1, 1)),
+                                      np.float64))
         return True
 
     def rollback_one_iter(self) -> None:
@@ -2165,7 +2174,23 @@ class GBDT:
             # convert_output operates class-major [K, N] like the
             # reference's ConvertOutput; predict_raw returns [N, K]
             r = raw.T if raw.ndim == 2 else raw
+            # pad the transform to the SAME serve bucket the forest
+            # predict rode: convert_output is a per-row jax op, so an
+            # online stream of odd batch sizes would otherwise
+            # re-trace it once per distinct size — a serving-path
+            # stall the bucketed forest predict already paid to avoid.
+            # Rows are independent (sigmoid/per-row softmax); the pad
+            # is sliced off, so results are bit-identical.
+            from ..ops import predict_cache
+            n = int(r.shape[-1])
+            cfg = self.config
+            b = predict_cache._bucket_rows(
+                n, cfg.tpu_serve_bucket if cfg is not None else None)
+            if b > n:
+                r = np.pad(np.asarray(r),
+                           [(0, 0)] * (r.ndim - 1) + [(0, b - n)])
             out = np.asarray(self.objective.convert_output(jnp.asarray(r)))
+            out = out[..., :n]
             return out.T if raw.ndim == 2 else out
         return raw
 

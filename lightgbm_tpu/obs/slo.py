@@ -230,9 +230,18 @@ class SloEngine:
     budget/burn state and publishes it as gauges."""
 
     def __init__(self, specs: List[SloSpec],
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 min_events: int = 0):
         self.specs = list(specs)
         self._reg = registry or default_registry()
+        # event floor for budget judgment: below this population a
+        # tail objective is statistically meaningless (ONE outlier
+        # "exhausts" a p99 budget over 10 events) — specs stay
+        # vacuously compliant, budget untouched, until the floor is
+        # met. 0 keeps the historical judge-from-event-1 behavior;
+        # the fleet admission controller (serve/daemon.py) sets ~100
+        # so a cold-start outlier cannot latch exhaustion.
+        self._min_events = max(int(min_events), 0)
         self._lock = lockorder.named_lock("obs.slo._lock")
         # per-spec accounting: cumulative (total, bad) at the last
         # evaluation (burn deltas), tick counts for gauge specs, and
@@ -338,8 +347,12 @@ class SloEngine:
                 else:
                     ok = (cur is None
                           or bool(spec.op_fn(cur, spec.threshold)))
-                budget_events = (1.0 - spec.objective) * total
-                if total:
+                warming = (spec.kind != "gauge" and self._min_events > 0
+                           and (total or 0) < self._min_events)
+                if warming:
+                    ok = True   # too few events to judge a tail
+                budget_events = (1.0 - spec.objective) * (total or 0)
+                if total and not warming:
                     remaining = (1.0 - bad / budget_events
                                  if budget_events > 0
                                  else (1.0 if not bad else 0.0))
@@ -364,6 +377,8 @@ class SloEngine:
                     "exhausted": bool(self._exhausted[i]
                                       or remaining <= 0.0),
                 }
+                if self._min_events:
+                    row["warming"] = warming
                 if remaining <= 0.0 and not self._exhausted[i]:
                     self._exhausted[i] = True
                     exhausted_now.append(row)

@@ -1,0 +1,41 @@
+"""Fleet serving: the networked multi-tenant scoring front.
+
+The paper's LRB workload is a *serving* system — every cache-admission
+decision is a predict call against the freshest sliding-window model —
+and the ROADMAP's north star is heavy traffic from millions of users.
+This package is the network front that turns concurrent traffic into
+throughput:
+
+- ``tenants.py``  — per-tenant boosters with versioned warm atomic
+  swap (``prepare_serving`` + publish-on-complete); N same-geometry
+  tenants share ONE compiled program through the process-wide predict
+  registry (ops/predict_cache.py), and the registry's hit counters
+  prove the cross-tenant reuse.
+- ``coalescer.py`` — the perf core: concurrent single/small-batch
+  requests queue into a bounded buffer; a dispatcher thread drains
+  them into one pow2-bucketed device batch per tick and slices the
+  results back per request — bit-identical to direct predict, but K
+  concurrent clients touch ~log distinct compiled programs instead of
+  paying K dispatches.
+- ``daemon.py``   — the stdlib ``http.server`` scoring endpoint (the
+  proven obs/export.py pattern) with SLO-driven admission control:
+  when a tenant's p99 error budget burns low, that tenant is shed
+  (429 + ``Retry-After``) BEFORE the breach while its neighbors keep
+  serving.
+- ``client.py``   — the stdlib urllib client; idempotent scoring
+  requests retry transient socket failures under the one bounded
+  backoff policy (utils/retry.py).
+
+Everything here is stdlib + numpy + the existing obs/ops plumbing —
+importing this package never touches jax (model loads do, lazily,
+exactly as direct capi serving would).
+"""
+from .client import FleetClient, ShedError
+from .coalescer import Coalescer, QueueFull
+from .daemon import ScoringDaemon
+from .tenants import TenantRegistry
+
+__all__ = [
+    "Coalescer", "FleetClient", "QueueFull", "ScoringDaemon",
+    "ShedError", "TenantRegistry",
+]

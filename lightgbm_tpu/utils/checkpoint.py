@@ -100,6 +100,13 @@ VOLATILE_KNOBS = frozenset({
     # the training math — a checkpoint written under int16 wire +
     # async slots restores under the legacy wire and vice versa
     "tpu_psum_wire", "tpu_async_psum", "tpu_ckpt_async",
+    # fleet-serving topology (serve/): where the scoring daemon
+    # listens, how long the coalescer lingers, queue depths and
+    # admission-SLO thresholds — pure serving-plane settings; the
+    # models a checkpoint restores are trained identically under any
+    # of them
+    "tpu_fleet_port", "tpu_fleet_coalesce_us", "tpu_fleet_max_batch",
+    "tpu_fleet_queue", "tpu_fleet_slo_p99_ms", "tpu_fleet_shed_budget",
 })
 
 

@@ -24,6 +24,13 @@ point                  where it fires
 ``lrb.window_train``   one sliding window's training in the lrb loop
                        (lrb.py — the degrade-don't-die path)
 ``export.write``       live metrics exporter snapshot (obs/export.py)
+``fleet.predict``      the scoring daemon's per-tenant dispatch, just
+                       before the device predict (serve/coalescer.py;
+                       context = tenant id)
+``fleet.predict.<t>``  same seam, but checked under a tenant-suffixed
+                       point name so a drill can target ONE tenant
+                       (the shed drill injects latency into a single
+                       tenant's stream while its neighbors stay fast)
 =====================  ====================================================
 
 Spec grammar (``configure(spec)`` / the ``tpu_faults`` config knob /
@@ -40,7 +47,11 @@ Occurrences are 1-based per point and counted process-wide; ``N+``
 means "every call from the N-th on". Actions: ``raise`` (default — a
 persistent ``InjectedFault``), ``transient`` (an
 ``InjectedFault(transient=True)``, which utils/retry.py classifies as
-retryable), ``kill`` (``SIGKILL`` to self — the crash drills).
+retryable), ``kill`` (``SIGKILL`` to self — the crash drills), and
+``sleep<ms>`` (e.g. ``sleep50`` — stall the call for that many
+milliseconds and then RETURN normally; a pure latency fault for the
+SLO/admission drills, where the failure mode under test is slowness,
+not an exception).
 
 Stdlib + obs only; importing this module never touches jax.
 """
@@ -77,11 +88,12 @@ class _Rule:
 
     def __init__(self, at=(), at_from: Optional[int] = None,
                  p: Optional[float] = None, action: str = "raise",
-                 seed: int = 0, point: str = ""):
+                 seed: int = 0, point: str = "", sleep_ms: float = 0.0):
         self.at = frozenset(int(x) for x in at)
         self.at_from = at_from
         self.p = p
         self.action = action
+        self.sleep_ms = float(sleep_ms)
         if p is not None:
             import random
             self.rng = random.Random(f"{seed}:{point}")
@@ -112,14 +124,25 @@ def _parse_spec(spec: str, seed: int) -> Dict[str, _Rule]:
         if "@" not in part:
             raise ValueError(f"fault spec {part!r}: want point@N[:action]")
         point, rest = part.split("@", 1)
-        action = "raise"
+        action, sleep_ms = "raise", 0.0
         if ":" in rest:
             rest, action = rest.rsplit(":", 1)
             action = action.strip().lower()
-            if action not in KNOWN_ACTIONS:
+            if action.startswith("sleep"):
+                try:
+                    sleep_ms = float(action[len("sleep"):] or "nan")
+                except ValueError:
+                    sleep_ms = float("nan")
+                if not sleep_ms >= 0.0:       # catches NaN too
+                    raise ValueError(
+                        f"fault spec {part!r}: want sleep<ms> with a "
+                        f"non-negative millisecond count (e.g. sleep50)")
+                action = "sleep"
+            elif action not in KNOWN_ACTIONS:
                 raise ValueError(
                     f"fault spec {part!r}: unknown action {action!r} "
-                    f"(want one of {'/'.join(KNOWN_ACTIONS)})")
+                    f"(want sleep<ms> or one of "
+                    f"{'/'.join(KNOWN_ACTIONS)})")
         rest = rest.strip()
         at, at_from, p = [], None, None
         if rest.startswith("p"):
@@ -136,7 +159,7 @@ def _parse_spec(spec: str, seed: int) -> Dict[str, _Rule]:
                     at.append(int(tok))
         name = point.strip()
         rules[name] = _Rule(at, at_from, p, action, seed=seed,
-                            point=name)
+                            point=name, sleep_ms=sleep_ms)
     return rules
 
 
@@ -222,6 +245,13 @@ def check(point: str, context=None) -> None:
     msg = (f"injected fault at {point} occurrence {count}{ctx} "
            f"[action={rule.action}]")
     log.warning("%s", msg)
+    if rule.action == "sleep":
+        # latency fault: stall, then let the call proceed — the caller
+        # never sees an exception, only the wall-clock damage (the
+        # admission-control drills assert on the p99 consequence)
+        import time
+        time.sleep(rule.sleep_ms / 1000.0)
+        return
     # black box BEFORE the blast: a kill action SIGKILLs the process —
     # this dump is the only evidence that will ever exist for it
     # (forced: the moment cannot recur; obs/flight.py)

@@ -51,6 +51,13 @@ TRANSIENT_MARKERS = (
     "Heartbeat timeout",
     "coordination service",             # service restarting
     "Coordination service",
+    # fleet scoring-daemon client blips (serve/client.py): a daemon
+    # mid model-swap or mid-restart drops the socket with these exact
+    # stdlib phrases (http.client.RemoteDisconnected / socket.timeout
+    # surfaced through urllib). Scoring requests are idempotent, so a
+    # bounded retry is always safe.
+    "Remote end closed connection",     # daemon dropped mid-response
+    "Read timed out",                   # response overdue, socket alive
 )
 
 

@@ -517,6 +517,156 @@ def test_cli_lrb_stream_walks_back_to_latest_carrier(tmp_path):
     assert cbr.main([str(lost), "--baseline-dir", str(base_dir)]) == 1
 
 
+# -- fleet serving (bench.py --fleet) gate -----------------------------------
+
+def _fleet_block(requests_per_s=130.0, worst_p99=70.0, **kw):
+    d = {"tenants": 4, "requests_per_tenant": 300,
+         "rows_per_request": 4, "streams_per_tenant": 2,
+         "coalesce_us": 2000,
+         "requests_per_s": requests_per_s,
+         "requests_per_s_sequential": 78.0,
+         "coalescing_speedup": 1.66,
+         "per_tenant": {
+             f"tenant_{i:02d}": {"requests": 300, "p50_ms": 30.0,
+                                 "p99_ms": (worst_p99 if i == 0
+                                            else 55.0),
+                                 "shed": 0}
+             for i in range(4)},
+         "registry_hit_rate": 0.75, "registry_lookups": 8,
+         "coalesced_batch_rows": {"batches": 505, "mean": 2.4,
+                                  "p50": 2.0, "p99": 8.0},
+         "shed_total": 0, "queue_rejects": 0,
+         "requests_total": 1364, "client_retries": 0}
+    d.update(kw)
+    return d
+
+
+def _fleet_doc(metric="fleet coalesced serving (4 tenants x 300 "
+                      "requests, 4-row requests)", **kw):
+    # top-level value pinned so these tests exercise the FLEET gates,
+    # not the generic throughput floor (which reads ``value``)
+    d = {"metric": metric, "unit": "requests/s", "value": 130.0,
+         "fleet": _fleet_block()}
+    d.update(kw)
+    return d
+
+
+def test_check_schema_fleet():
+    # the standalone --fleet line: unit requests/s + fleet block, and
+    # it must NOT be mistaken for an lrb_stream artifact
+    assert cbr.check_schema(_fleet_doc()) == []
+    # missing gate fields are named
+    for k in ("requests_per_s", "requests_per_s_sequential",
+              "shed_total", "queue_rejects", "tenants"):
+        broken = _fleet_block()
+        del broken[k]
+        assert any(f"fleet.{k}" in p for p in
+                   cbr.check_schema(_fleet_doc(fleet=broken)))
+    # per-tenant quantiles must be numeric; null is a problem (a shed
+    # count of 0 is fine, a MISSING quantile is lost evidence)
+    broken = _fleet_block()
+    broken["per_tenant"]["tenant_00"]["p99_ms"] = None
+    assert any("per_tenant.tenant_00.p99_ms" in p for p in
+               cbr.check_schema(_fleet_doc(fleet=broken)))
+    assert any("per_tenant" in p for p in cbr.check_schema(
+        _fleet_doc(fleet=_fleet_block(per_tenant={}))))
+    assert any("per_tenant.t is" in p for p in cbr.check_schema(
+        _fleet_doc(fleet=_fleet_block(per_tenant={"t": "n/a"}))))
+    # registry hit rate: null only legitimate with zero lookups
+    assert any("registry_hit_rate null" in p for p in cbr.check_schema(
+        _fleet_doc(fleet=_fleet_block(registry_hit_rate=None))))
+    assert cbr.check_schema(_fleet_doc(fleet=_fleet_block(
+        registry_hit_rate=None, registry_lookups=0))) == []
+    assert any("registry_hit_rate is" in p for p in cbr.check_schema(
+        _fleet_doc(fleet=_fleet_block(registry_hit_rate="n/a"))))
+    # batch-size histogram must exist (coalescing evidence)
+    assert any("coalesced_batch_rows" in p for p in cbr.check_schema(
+        _fleet_doc(fleet=_fleet_block(coalesced_batch_rows=None))))
+    assert any("coalesced_batch_rows.batches" in p
+               for p in cbr.check_schema(_fleet_doc(
+                   fleet=_fleet_block(coalesced_batch_rows={}))))
+    # wrong container type is reported, not crashed on
+    assert any("not a dict" in p for p in
+               cbr.check_schema(_fleet_doc(fleet="n/a")))
+
+
+def test_compare_fleet_gate():
+    base = _fleet_doc()
+    # within tolerance: pass
+    assert cbr.compare(_fleet_doc(fleet=_fleet_block(
+        requests_per_s=110.0, worst_p99=90.0)), base) == []
+    # aggregate requests/s floor (same 20% tolerance as throughput)
+    probs = cbr.compare(_fleet_doc(fleet=_fleet_block(
+        requests_per_s=60.0)), base)
+    assert probs and "fleet-throughput regression" in probs[0]
+    # worst-tenant p99 ceiling — no tenant's tail may quietly rot
+    # behind a healthy aggregate
+    probs = cbr.compare(_fleet_doc(fleet=_fleet_block(
+        worst_p99=500.0)), base)
+    assert probs and "fleet-latency regression" in probs[0] \
+        and "worst-tenant p99" in probs[0]
+    # tolerance knobs reach both gates
+    assert cbr.compare(_fleet_doc(fleet=_fleet_block(
+        requests_per_s=60.0)), base, throughput_tol=0.6) == []
+    assert cbr.compare(_fleet_doc(fleet=_fleet_block(
+        worst_p99=500.0)), base, latency_tol=9.0) == []
+    # old baselines without the section gate nothing
+    no_fleet = dict(_fleet_doc())
+    del no_fleet["fleet"]
+    assert cbr.compare(_fleet_doc(fleet=_fleet_block(
+        requests_per_s=1.0, worst_p99=9999.0)), no_fleet) == []
+    # a fresh run that LOST the section cannot silently pass
+    probs = cbr.compare(no_fleet, base)
+    assert any("no fleet.requests_per_s" in p for p in probs)
+    assert any("no fleet per-tenant p99_ms" in p for p in probs)
+    # a baseline with a DIFFERENT fleet shape gates nothing: 8-tenant
+    # requests/s is not a comparable floor for a 4-tenant run
+    assert cbr.compare(_fleet_doc(fleet=_fleet_block(
+        requests_per_s=1.0, tenants=8)), base) == []
+    assert cbr.compare(_fleet_doc(fleet=_fleet_block(
+        requests_per_s=1.0, streams_per_tenant=8)), base) == []
+    # cross-workload refusal still wins: a fleet line never compares
+    # against a HIGGS training baseline
+    probs = cbr.compare(_fleet_doc(), _fresh())
+    assert len(probs) == 1 and "not comparable" in probs[0]
+
+
+def test_cli_fleet_walks_back_to_latest_carrier(tmp_path):
+    """When the newest trajectory point predates the fleet bench, the
+    fleet fields gate against the LATEST same-workload point carrying
+    a comparable shape — old points gate nothing beyond that."""
+    base_dir = tmp_path / "repo"
+    base_dir.mkdir()
+    (base_dir / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": _fleet_doc()}))
+    (base_dir / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": _fleet_doc(fleet=None)}))  # newest: no fleet block
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(_fleet_doc(fleet=_fleet_block(
+        requests_per_s=40.0))))
+    assert cbr.main([str(slow), "--baseline-dir", str(base_dir)]) == 1
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_fleet_doc(fleet=_fleet_block(
+        requests_per_s=125.0))))
+    assert cbr.main([str(ok), "--baseline-dir", str(base_dir)]) == 0
+    # the tolerance flags reach the walked-back comparison
+    assert cbr.main([str(slow), "--baseline-dir", str(base_dir),
+                     "--throughput-tol", "0.8"]) == 0
+    tail = tmp_path / "tail.json"
+    tail.write_text(json.dumps(_fleet_doc(fleet=_fleet_block(
+        worst_p99=500.0))))
+    assert cbr.main([str(tail), "--baseline-dir", str(base_dir)]) == 1
+    assert cbr.main([str(tail), "--baseline-dir", str(base_dir),
+                     "--latency-tol", "9.0"]) == 0
+    # a newest point carrying a DIFFERENT fleet shape must not disable
+    # the gate either: walk back to the same-shape carrier
+    (base_dir / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": _fleet_doc(fleet=_fleet_block(
+            requests_per_s=5000.0, tenants=16))}))
+    assert cbr.main([str(slow), "--baseline-dir", str(base_dir)]) == 1
+    assert cbr.main([str(ok), "--baseline-dir", str(base_dir)]) == 0
+
+
 # -- the slo section (obs/slo.py budget report in bench JSON) ----------------
 
 def _slo_block(**kw):

@@ -133,6 +133,24 @@ def test_fault_spec_rejects_garbage():
     assert not faults.active()
 
 
+def test_fault_sleep_action_stalls_without_raising():
+    """The latency action (shed drills): the call stalls for the
+    configured milliseconds and then proceeds normally — no exception,
+    no flight dump, only the wall-clock damage."""
+    import time as _t
+    faults.configure("p.s@2+:sleep40")
+    t0 = _t.perf_counter()
+    faults.check("p.s")                      # occurrence 1: clean
+    assert _t.perf_counter() - t0 < 0.030
+    t0 = _t.perf_counter()
+    faults.check("p.s")                      # 2+: stalls, returns
+    assert _t.perf_counter() - t0 >= 0.030
+    assert faults.counts()["p.s"] == 2
+    for bad in ("p.s@1:sleepX", "p.s@1:sleep-5", "p.s@1:sleep"):
+        with pytest.raises(ValueError, match="sleep<ms>"):
+            faults.configure(bad)
+
+
 def test_retry_recovers_transient_and_fails_fast():
     calls = {"n": 0}
 

@@ -1,0 +1,408 @@
+"""Serving fleet (lightgbm_tpu/serve/): coalescer bit-parity against
+direct predict (in-process AND over the HTTP wire), cross-tenant
+compiled-program reuse through the predict registry, versioned warm
+swap under load, the SLO admission-control shed drill (latency fault
+burns one tenant's p99 budget -> 429 pre-breach while neighbors keep
+serving), bounded-queue backpressure, and daemon lifecycle.
+
+``pytest -m fleet``.
+"""
+import threading
+import time
+import urllib.error
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import capi
+from lightgbm_tpu.ops import predict_cache
+from lightgbm_tpu.serve import (Coalescer, FleetClient, QueueFull,
+                                ScoringDaemon, ShedError,
+                                TenantRegistry)
+from lightgbm_tpu.serve import client as serve_client
+from lightgbm_tpu.obs import registry as obs
+from lightgbm_tpu.utils import faults
+
+pytestmark = pytest.mark.fleet
+
+_PARAMS = ("objective=binary num_leaves=15 max_bin=63 "
+           "min_data_in_leaf=5 verbose=-1")
+
+
+def _train_model_str(params=_PARAMS, n=300, f=6, iters=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    ds = capi.LGBM_DatasetCreateFromMat(X, parameters=params)
+    capi.LGBM_DatasetSetField(ds, "label", y)
+    bst = capi.LGBM_BoosterCreate(ds, params)
+    for _ in range(iters):
+        if capi.LGBM_BoosterUpdateOneIter(bst):
+            break
+    return capi.LGBM_BoosterSaveModelToString(bst)
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    return _train_model_str(seed=0)
+
+
+@pytest.fixture(scope="module")
+def binary_model_v2():
+    # same geometry knobs, different data: a distinguishable version
+    return _train_model_str(seed=9)
+
+
+@pytest.fixture(scope="module")
+def multiclass_model():
+    params = ("objective=multiclass num_class=3 num_leaves=15 "
+              "max_bin=63 min_data_in_leaf=5 verbose=-1")
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 6))
+    y = (np.abs(X[:, 0]) + X[:, 1] > 0.8).astype(np.float32) \
+        + (X[:, 2] > 0.5)
+    ds = capi.LGBM_DatasetCreateFromMat(X, parameters=params)
+    capi.LGBM_DatasetSetField(ds, "label", y.astype(np.float32))
+    bst = capi.LGBM_BoosterCreate(ds, params)
+    for _ in range(6):
+        capi.LGBM_BoosterUpdateOneIter(bst)
+    return capi.LGBM_BoosterSaveModelToString(bst)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def make_daemon():
+    """Daemon factory that guarantees stop() even on assertion
+    failure (the listener and dispatcher are process-global
+    resources)."""
+    made = []
+
+    def _make(**kw):
+        d = ScoringDaemon(port=0, **kw).start()
+        made.append(d)
+        return d
+
+    yield _make
+    for d in made:
+        d.stop()
+
+
+def _direct(model_str, X):
+    """What an uncoalesced caller would get: the exact serving call
+    on a freshly loaded handle."""
+    h = capi.LGBM_BoosterLoadModelFromString(model_str)
+    return np.asarray(capi.LGBM_BoosterPredictForMat(
+        h, X, predict_type=capi.C_API_PREDICT_NORMAL))
+
+
+# -- tenant registry units ---------------------------------------------------
+
+def test_tenant_name_validation():
+    assert TenantRegistry.validate_name("tenant_07") == "tenant_07"
+    for bad in ("", "UPPER", "has-dash", "a" * 65, "sp ace"):
+        with pytest.raises(ValueError, match="tenant name"):
+            TenantRegistry.validate_name(bad)
+
+
+def test_registry_swap_and_drop(binary_model):
+    reg = TenantRegistry(warm_rows=4)
+    assert reg.register("t", binary_model) == 1
+    h1, v1 = reg.get("t")
+    assert v1 == 1
+    assert reg.register("t", binary_model) == 2   # swap bumps version
+    _, v2 = reg.get("t")
+    assert v2 == 2
+    assert reg.stats()["tenants"]["t"]["version"] == 2
+    assert reg.drop("t") and not reg.drop("t")
+    with pytest.raises(KeyError):
+        reg.get("t")
+
+
+# -- coalescer bit-parity ----------------------------------------------------
+
+def test_coalesced_parity_concurrent_odd_batches(
+        make_daemon, binary_model, multiclass_model):
+    """Many concurrent small requests (1-row, odd sizes, two tenants
+    with DIFFERENT model shapes) coalesced into shared device batches
+    return exactly the bytes each request would have gotten alone."""
+    d = make_daemon(coalesce_us=3000, warm_rows=16)
+    d.register_tenant("bin", binary_model)
+    d.register_tenant("multi", multiclass_model)
+    rng = np.random.default_rng(11)
+    Xt = rng.normal(size=(120, 6))
+    Xt[::7, 3] = np.nan                       # missing values ride too
+    want = {"bin": _direct(binary_model, Xt),
+            "multi": _direct(multiclass_model, Xt)}
+    # odd slice ladder incl. 1-row requests
+    sizes = (1, 3, 5, 17, 94)
+    jobs = []
+    for tenant in ("bin", "multi"):
+        r0 = 0
+        for i in range(999):
+            b = sizes[i % len(sizes)]
+            if r0 >= len(Xt):
+                break
+            jobs.append((tenant, r0, min(b, len(Xt) - r0)))
+            r0 += b
+    out = {}
+    errs = []
+
+    def worker(tenant, r0, b):
+        try:
+            preds, version = d.predict(tenant, Xt[r0:r0 + b])
+            out[(tenant, r0)] = (np.asarray(preds), version)
+        except Exception as e:                # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=j) for j in jobs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs
+    assert len(out) == len(jobs)
+    for (tenant, r0), (preds, version) in out.items():
+        b = preds.shape[0]
+        np.testing.assert_array_equal(preds, want[tenant][r0:r0 + b])
+        assert version == 1
+    # the point of the exercise: at least one real multi-request batch
+    snap = obs.histogram("fleet/coalesced_batch_rows").snapshot()
+    assert snap["count"] > 0
+
+
+def test_http_roundtrip_bit_parity(make_daemon, binary_model):
+    """Predictions over the JSON wire equal in-process predict to the
+    last bit (float64 shortest-round-trip repr)."""
+    d = make_daemon(coalesce_us=0)
+    client = FleetClient(d.url)
+    assert client.register("wire", binary_model, warm_rows=8) == 1
+    rng = np.random.default_rng(5)
+    for rows in (1, 7, 33):
+        Xb = rng.normal(size=(rows, 6))
+        got, version = client.predict_versioned("wire", Xb)
+        assert version == 1
+        np.testing.assert_array_equal(got, _direct(binary_model, Xb))
+    assert "wire" in client.health()["tenants"]
+    assert client.tenants()["tenants"]["tenants"]["wire"][
+        "version"] == 1
+
+
+# -- cross-tenant compiled-program reuse -------------------------------------
+
+def test_same_geometry_tenants_share_compiled_program(
+        make_daemon, binary_model):
+    """N same-geometry tenants, one compiled program: every
+    registration after the first warms against a predict-registry HIT
+    (no re-trace), which is the --fleet acceptance bar of hit rate
+    >= 3/4 at K=4."""
+    if not predict_cache.enabled():
+        pytest.skip("predict registry disabled in this environment")
+    d = make_daemon(coalesce_us=0, warm_rows=16)
+    before = predict_cache.stats()
+    for i in range(4):
+        d.register_tenant(f"tenant_{i:02d}", binary_model)
+    after = predict_cache.stats()
+    lookups = (after["hits"] + after["misses"]
+               - before["hits"] - before["misses"])
+    hits = after["hits"] - before["hits"]
+    misses = after["misses"] - before["misses"]
+    assert lookups >= 4, "warmup never reached the predict registry"
+    # only the FIRST tenant may compile; 3 of 4 must reuse
+    assert hits / lookups >= 0.75, (hits, misses)
+    # steady serving is memoized: scoring all tenants adds no misses
+    rng = np.random.default_rng(2)
+    Xb = rng.normal(size=(8, 6))
+    mid = predict_cache.stats()
+    for i in range(4):
+        preds, _ = d.predict(f"tenant_{i:02d}", Xb)
+        np.testing.assert_array_equal(preds, _direct(binary_model, Xb))
+    assert predict_cache.stats()["misses"] == mid["misses"]
+
+
+# -- versioned warm swap under load ------------------------------------------
+
+def test_swap_under_load_every_response_is_some_clean_version(
+        make_daemon, binary_model, binary_model_v2):
+    """Hammer one tenant while models swap underneath: every response
+    must bit-equal a clean predict at the version it claims — never a
+    torn read, and in-flight requests finish on the old model."""
+    d = make_daemon(coalesce_us=0, warm_rows=8)
+    d.register_tenant("swap", binary_model)
+    rng = np.random.default_rng(7)
+    Xb = rng.normal(size=(6, 6))
+    want = {1: _direct(binary_model, Xb),
+            2: _direct(binary_model_v2, Xb),
+            3: _direct(binary_model, Xb)}
+    stop = threading.Event()
+    got, errs = [], []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                preds, version = d.predict("swap", Xb)
+                got.append((version, np.asarray(preds)))
+            except Exception as e:            # noqa: BLE001
+                errs.append(e)
+                return
+
+    def wait_seen(version, deadline_s=30.0):
+        # publish timing is load-dependent: wait until the hammer
+        # actually OBSERVES the version instead of sleeping blind
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            if errs or any(v == version for v, _ in list(got)):
+                return
+            time.sleep(0.002)
+        raise AssertionError(f"version {version} never served")
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    assert d.register_tenant("swap", binary_model_v2) == 2
+    wait_seen(2)
+    assert d.register_tenant("swap", binary_model) == 3
+    wait_seen(3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errs and got
+    for version, preds in got:
+        np.testing.assert_array_equal(preds, want[version])
+    assert obs.counter("fleet/model_swaps").value >= 2
+
+
+# -- SLO admission control: the shed drill -----------------------------------
+
+def test_shed_drill_pre_breach_and_neighbor_isolation(
+        make_daemon, binary_model):
+    """Inject a latency fault into ONE tenant's predict path: its p99
+    budget burns, admission sheds it with 429 BEFORE the budget is
+    exhausted (pre-breach, by the snapshotted remaining budget), the
+    neighbor tenant keeps serving, and the probe trickle keeps the
+    shed tenant's recovery possible."""
+    d = make_daemon(coalesce_us=0, slo_p99_ms=50.0, shed_budget=0.5,
+                    slo_eval_gap_s=0.0, slo_min_events=100,
+                    shed_probe_every=16)
+    d.register_tenant("alpha", binary_model)
+    d.register_tenant("beta", binary_model)
+    x1 = np.zeros((1, 6))
+    shed0 = obs.counter("fleet/shed_total").value
+    # prefill: a healthy latency history for both tenants (also takes
+    # the engine past its min_events warming floor)
+    for _ in range(400):
+        d.predict("alpha", x1)
+        d.predict("beta", x1)
+    assert d.shed_check("alpha") is None      # healthy: admitted
+    # now alpha's every predict stalls 80ms — past the 50ms objective
+    faults.configure("fleet.predict.alpha@1+:sleep80")
+    shed_at = None
+    for i in range(12):
+        try:
+            d.predict("alpha", x1)
+        except ShedError as e:
+            shed_at = i
+            assert e.tenant == "alpha" and e.retry_after_s > 0
+            break
+    assert shed_at is not None, "admission never shed the slow tenant"
+    report = d.slo_report()
+    state = report["shedding"]["alpha"]
+    # the drill's proof that admission acted PRE-breach: budget
+    # remained when shedding began, and it was not exhausted
+    assert state["budget_remaining_at_shed"] > 0
+    assert state["exhausted_at_shed"] is False
+    # while shed, requests are refused fast (modulo the probe trickle)
+    sheds = 0
+    for _ in range(20):
+        try:
+            d.predict("alpha", x1)
+        except ShedError:
+            sheds += 1
+    assert sheds >= 15
+    assert obs.counter("fleet/shed_total").value - shed0 >= 15
+    assert obs.counter("fleet/shed/alpha").value >= 15
+    # neighbor isolation: beta's budget is untouched, it still serves
+    preds, _ = d.predict("beta", x1)
+    np.testing.assert_array_equal(preds, _direct(binary_model, x1))
+    assert "beta" not in d.slo_report()["shedding"]
+    # the wire surface agrees: HTTP 429 + Retry-After -> ShedError
+    client = FleetClient(d.url)
+    with pytest.raises(ShedError) as ei:
+        for _ in range(3):                    # skip a probe admit
+            client.predict("alpha", x1)
+    assert ei.value.retry_after_s > 0
+
+
+# -- backpressure ------------------------------------------------------------
+
+def test_bounded_queue_refuses_then_drains(binary_model):
+    reg = TenantRegistry(warm_rows=4)
+    reg.register("t", binary_model)
+    rejects0 = obs.counter("fleet/queue_rejects").value
+    co = Coalescer(reg, max_wait_us=0, max_queue=2)
+    # dispatcher not started: submissions pile into the bounded buffer
+    f1 = co.submit("t", np.zeros((1, 6)))
+    f2 = co.submit("t", np.zeros((1, 6)))
+    with pytest.raises(QueueFull) as ei:
+        co.submit("t", np.zeros((1, 6)))
+    assert ei.value.retry_after_s > 0
+    assert obs.counter("fleet/queue_rejects").value == rejects0 + 1
+    # starting the dispatcher drains what was queued
+    co.start()
+    preds, version = f1.result(timeout=30)
+    assert version == 1 and preds.shape[0] == 1
+    f2.result(timeout=30)
+    co.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        co.submit("t", np.zeros((1, 6)))
+
+
+# -- daemon lifecycle + client classification --------------------------------
+
+def test_daemon_lifecycle_and_from_config(binary_model):
+    d = ScoringDaemon.from_config(
+        {"tpu_fleet_coalesce_us": 123, "tpu_fleet_slo_p99_ms": 10.0,
+         "tpu_fleet_shed_budget": 0.4})
+    assert d.coalescer._wait_s == pytest.approx(123 / 1e6)
+    assert d._slo_p99_ms == 10.0 and d._shed_budget == 0.4
+    d.start()
+    assert d.start() is d                     # idempotent start
+    port = d.http_port
+    assert port > 0                           # ephemeral bind resolved
+    assert d.url.endswith(f":{port}")
+    client = FleetClient(d.url)
+    assert client.health()["ok"] is True
+    # unknown tenant is a caller bug: 404, fail fast (never retried)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        client.predict("nobody", np.zeros((1, 6)))
+    assert ei.value.code == 404
+    d.stop()
+    d.stop()                                  # idempotent stop
+    with pytest.raises(RuntimeError, match="stopped"):
+        d.predict("nobody", np.zeros((1, 6)))
+
+
+def test_client_transient_classification():
+    """429 is admission (never retried); 503 is backpressure
+    (retried); 404 is a caller bug (fail fast); socket-level failures
+    are transient."""
+    assert serve_client._classify(ShedError("t", 0.5)) is False
+    assert serve_client._classify(
+        urllib.error.HTTPError("u", 503, "busy", None, None)) is True
+    assert serve_client._classify(
+        urllib.error.HTTPError("u", 502, "bad gw", None, None)) is True
+    assert serve_client._classify(
+        urllib.error.HTTPError("u", 404, "nope", None, None)) is False
+    assert serve_client._classify(
+        urllib.error.URLError(ConnectionRefusedError(
+            "Connection refused"))) is True
+    assert serve_client._classify(
+        ConnectionResetError("Connection reset by peer")) is True
+    assert serve_client._classify(
+        RuntimeError("Remote end closed connection without "
+                     "response")) is True
+    assert serve_client._classify(ValueError("bad rows")) is False

@@ -25,6 +25,14 @@ the numbers. This tool makes the comparison mechanical:
   reference was importable the per-tier AUC delta must stay under the
   recorded ceiling (the reference's own ~4e-4 GPU-vs-CPU bar); a run
   where the reference was unavailable must RECORD its skip reason;
+- **fleet serving** (``bench.py --fleet``): the ``fleet`` section
+  (unit ``requests/s``, like the lrb-stream line — the section key
+  disambiguates) gates aggregate coalesced requests/s as a floor
+  (``--throughput-tol``) and the WORST tenant's client p99 as a
+  ceiling (``--latency-tol``) against the latest trajectory point
+  carrying a comparable fleet shape (tenants x requests x rows x
+  streams); per-tenant quantiles, shed counts and the registry hit
+  rate are shape-validated;
 - **SLO section**: a fresh run carrying an ``slo`` section (obs/slo.py
   budget report: remaining error budget, burn rate, p99.9 tails) has
   its SHAPE validated — budget fields numeric-or-null, per-objective
@@ -116,18 +124,25 @@ def check_schema(fresh: dict) -> List[str]:
     line (also unit ``rows/s`` — the two share the unit, so the
     section key disambiguates: memory-vs-OOC routes under ``rank``);
     a training line may also CARRY an ``lrb_stream`` section (the
-    appended compact stream bench)."""
+    appended compact stream bench). The ``bench.py --fleet`` line
+    shares the requests/s unit with the stream line; the ``fleet``
+    section key disambiguates."""
     problems = []
-    stream_only = fresh.get("unit") == "requests/s"
+    fleet_only = (fresh.get("unit") == "requests/s"
+                  and fresh.get("fleet") is not None)
+    stream_only = (fresh.get("unit") == "requests/s"
+                   and not fleet_only)
     rank_only = (fresh.get("unit") == "rows/s"
                  and isinstance(fresh.get("rank"), (dict, list, str)))
     sparse_only = fresh.get("unit") == "rows/s" and not rank_only
     if not isinstance(fresh.get("value"), (int, float)):
         problems.append("missing numeric 'value' "
-                        + ("(requests/s)" if stream_only
+                        + ("(requests/s)" if stream_only or fleet_only
                            else "(rows/s)" if sparse_only or rank_only
                            else "(M row-iters/s)"))
-    if stream_only:
+    if fleet_only:
+        pass                      # shape gated below with the section
+    elif stream_only:
         if not isinstance(fresh.get("lrb_stream"), dict):
             problems.append("unit requests/s but no 'lrb_stream' "
                             "object")
@@ -157,6 +172,7 @@ def check_schema(fresh: dict) -> List[str]:
                 problems.append(
                     "lrb_stream.serve_p99_during_retrain_ms is "
                     f"{type(p99d).__name__}, not numeric/null")
+    problems += _check_fleet_schema(fresh.get("fleet"))
     sp = fresh.get("sparse")
     if sp is not None:
         if not isinstance(sp, dict):
@@ -234,6 +250,60 @@ def check_schema(fresh: dict) -> List[str]:
                     problems.append(f"predict_latency.{q} missing/null")
     problems += _check_slo_schema(fresh.get("slo"))
     problems += _check_parity_schema(fresh.get("parity"))
+    return problems
+
+
+def _check_fleet_schema(fl) -> List[str]:
+    """Shape problems in the ``fleet`` section (bench.py --fleet):
+    both phases' aggregate rates, the per-tenant client quantiles,
+    the shed/queue counters and the registry hit rate must be present
+    and numeric — an artifact that silently lost the multi-tenant
+    evidence must not pass as "nothing to gate". The admission budget
+    state rides along as ``slo_admission`` but is an operator signal,
+    not a schema requirement (a daemon with shedding disabled has
+    none)."""
+    if fl is None:
+        return []
+    if not isinstance(fl, dict):
+        return [f"fleet is {type(fl).__name__}, not a dict"]
+    problems = []
+    for k in ("tenants", "requests_per_tenant", "rows_per_request",
+              "requests_per_s", "requests_per_s_sequential",
+              "shed_total", "queue_rejects"):
+        if not _num(fl.get(k)):
+            problems.append(f"fleet.{k} missing/null")
+    # one compiled program across same-geometry tenants is the whole
+    # point — the rate may legitimately be null only when there were
+    # no registry lookups at all
+    hit = fl.get("registry_hit_rate")
+    if hit is None:
+        if _num(fl.get("registry_lookups")) and fl["registry_lookups"]:
+            problems.append("fleet.registry_hit_rate null with "
+                            "nonzero registry_lookups")
+    elif not _num(hit):
+        problems.append(f"fleet.registry_hit_rate is "
+                        f"{type(hit).__name__}, not numeric/null")
+    pt = fl.get("per_tenant")
+    if not (isinstance(pt, dict) and pt):
+        problems.append("fleet.per_tenant missing/not a non-empty "
+                        "dict")
+        pt = {}
+    for t, row in sorted(pt.items()):
+        if not isinstance(row, dict):
+            problems.append(f"fleet.per_tenant.{t} is "
+                            f"{type(row).__name__}, not a dict")
+            continue
+        for k in ("p50_ms", "p99_ms", "shed"):
+            if not _num(row.get(k)):
+                problems.append(f"fleet.per_tenant.{t}.{k} "
+                                "missing/null")
+    cb = fl.get("coalesced_batch_rows")
+    if not isinstance(cb, dict):
+        problems.append("fleet.coalesced_batch_rows missing/not a "
+                        "dict")
+    elif not _num(cb.get("batches")):
+        problems.append("fleet.coalesced_batch_rows.batches "
+                        "missing/null")
     return problems
 
 
@@ -402,6 +472,8 @@ def compare(fresh: dict, baseline: dict,
     problems += _compare_latency(fresh, baseline, latency_tol)
     problems += _compare_lrb_stream(fresh, baseline, throughput_tol,
                                     staleness_slack)
+    problems += _compare_fleet(fresh, baseline, throughput_tol,
+                               latency_tol)
     problems += _compare_parity(fresh, baseline, throughput_tol)
     problems += _compare_rank(fresh, baseline, auc_tol, latency_tol)
     return problems
@@ -535,6 +607,83 @@ def _compare_parity(fresh: dict, baseline: dict,
                     f"exact-tier throughput regression: {frate:g} "
                     f"M row-iters/s < {floor:g} (baseline {brate:g} - "
                     f"{throughput_tol:.0%})")
+    return problems
+
+
+def _fleet_shape(fl: dict) -> tuple:
+    """The fleet workload shape — requests/s over 2 tenants is not a
+    comparable floor for 8, nor 1-row requests for 64-row ones."""
+    return tuple(fl.get(k) for k in ("tenants", "requests_per_tenant",
+                                     "rows_per_request",
+                                     "streams_per_tenant"))
+
+
+def _fleet_comparable(fresh: dict, baseline: dict) -> bool:
+    """True when the baseline's fleet block can gate this fresh run:
+    it exists and matches the fresh run's fleet shape (the metric
+    string embeds tenants x requests x rows, but streams_per_tenant
+    only lives in the section)."""
+    bf = baseline.get("fleet")
+    if not isinstance(bf, dict):
+        return False
+    ff = fresh.get("fleet")
+    if not isinstance(ff, dict):
+        return True         # lost-section check still applies
+    return _fleet_shape(ff) == _fleet_shape(bf)
+
+
+def _fleet_worst_p99(fl: dict):
+    pt = fl.get("per_tenant")
+    if not isinstance(pt, dict):
+        return None
+    vals = [row.get("p99_ms") for row in pt.values()
+            if isinstance(row, dict) and _num(row.get("p99_ms"))]
+    return max(vals) if vals else None
+
+
+def _compare_fleet(fresh: dict, baseline: dict, throughput_tol: float,
+                   latency_tol: float) -> List[str]:
+    """Fleet-serving gate (``fleet`` section): aggregate coalesced
+    requests/s is a floor (``--throughput-tol``, like every
+    throughput) and the WORST tenant's client p99 is a ceiling
+    (``--latency-tol`` — multi-tenant isolation means no tenant's
+    tail may quietly rot behind a healthy aggregate). Only fires when
+    the BASELINE carries a comparable fleet shape; a fresh run that
+    LOST the section against a carrier is itself a problem."""
+    bf = baseline.get("fleet")
+    if not isinstance(bf, dict):
+        return []
+    if not _fleet_comparable(fresh, baseline):
+        return []
+    ff_raw = fresh.get("fleet")
+    ff = ff_raw if isinstance(ff_raw, dict) else {}
+    problems = []
+    brps = bf.get("requests_per_s")
+    if _num(brps):
+        frps = ff.get("requests_per_s")
+        if not _num(frps):
+            problems.append("fresh run carries no "
+                            "fleet.requests_per_s to compare")
+        else:
+            floor = (1.0 - throughput_tol) * brps
+            if frps < floor:
+                problems.append(
+                    f"fleet-throughput regression: {frps:g} "
+                    f"requests/s < {floor:g} (baseline {brps:g} - "
+                    f"{throughput_tol:.0%})")
+    bp99 = _fleet_worst_p99(bf)
+    if _num(bp99):
+        fp99 = _fleet_worst_p99(ff)
+        if not _num(fp99):
+            problems.append("fresh run carries no fleet per-tenant "
+                            "p99_ms to compare")
+        else:
+            ceil = (1.0 + latency_tol) * bp99
+            if fp99 > ceil:
+                problems.append(
+                    f"fleet-latency regression: worst-tenant p99 "
+                    f"{fp99:g} ms > {ceil:g} (baseline {bp99:g} + "
+                    f"{latency_tol:.0%})")
     return problems
 
 
@@ -1072,6 +1221,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                 got = _compare_lrb_stream(fresh, cand,
                                           args.throughput_tol,
                                           args.staleness_slack)
+                if got:
+                    problems = got
+                    baseline_name = os.path.basename(p)
+                break
+    # same walk-back for the fleet section: gate against the latest
+    # same-workload point CARRYING a comparable fleet shape
+    if not problems and not _fleet_comparable(fresh, baseline):
+        for p, cand in reversed(matching[:-1]):
+            if _fleet_comparable(fresh, cand):
+                got = _compare_fleet(fresh, cand,
+                                     args.throughput_tol,
+                                     args.latency_tol)
                 if got:
                     problems = got
                     baseline_name = os.path.basename(p)
